@@ -1,0 +1,25 @@
+"""multihop_offload_tpu — TPU-native framework for congestion-aware distributed
+task offloading in wireless multi-hop networks using GNNs.
+
+A ground-up JAX/XLA re-design of the capabilities of
+``zhongyuanzhao/multihop-offload`` (ICASSP 2024, arXiv:2312.02471).  The
+reference is a single-process eager-TensorFlow + NetworkX program; this
+framework instead expresses the entire pipeline — extended-line-graph
+construction, the Chebyshev GNN, min-plus all-pairs shortest paths, greedy
+routing, the contention-coupled queueing model, and the actor/analytic-critic
+training math — as pure, fixed-shape JAX computations that `vmap` over batches
+of network instances and `shard_map` over TPU meshes.
+
+Layout:
+  graphs/    host-side topology generation + padded Instance pytrees
+  env/       queueing environment as JAX ops (APSP, routing, offloading, run)
+  models/    Chebyshev-polynomial GNN (flax) + reference checkpoint importer
+  agent/     actor forward / policy-eval / training-math core / replay
+  parallel/  device meshes, data parallelism, ring-sharded min-plus APSP
+  train/     drivers, metrics, checkpointing
+  cli/       train / test / datagen / bench entry points
+"""
+
+__version__ = "0.1.0"
+
+from multihop_offload_tpu.config import Config  # noqa: F401

@@ -1,0 +1,110 @@
+"""Single dataclass-based configuration shared by every entry point.
+
+Replaces the reference's `tf.compat.v1.flags` singleton
+(`/root/reference/src/gnn_offloading_agent.py:42-60`) and the argparse CLI of
+its data generator (`data_generation_offloading.py:18-23`).  Flag names and
+defaults mirror the reference so the bash workflows translate 1:1; TPU-specific
+knobs (padding, batching, mesh shape, dtype, Chebyshev order) are new.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class Config:
+    # ---- reference flags (gnn_offloading_agent.py:42-60) -------------------
+    datapath: str = "data/aco_data_ba_100"
+    out: str = "out"
+    T: int = 1000                  # congestion-penalty scale t_max
+    prob: bool = False             # softmax-sample the offloading decision
+    training_set: str = "BAm2"     # checkpoint directory tag
+    learning_rate: float = 1e-4
+    learning_decay: float = 1.0    # exponential LR decay rate (1.0 = constant)
+    arrival_scale: float = 0.1
+    epochs: int = 201
+    num_layer: int = 5
+    dropout: float = 0.0
+    weight_decay: float = 5e-4     # L2 regularization scale (kept for parity)
+    epsilon: float = 1.0           # legacy replay-epsilon (decayed, unused by
+    epsilon_min: float = 0.001     # action selection — reference quirk kept
+    epsilon_decay: float = 0.985   # for parity; see SURVEY.md §8)
+    gamma: float = 1.0             # unused by the reference; kept for parity
+    batch: int = 100               # replay minibatch (number of stored grads)
+
+    # ---- reference driver-level constants (AdHoc_train.py) -----------------
+    num_instances: int = 10        # job-placement instances per network
+    explore: float = 0.1           # driver-level epsilon-greedy exploration
+    explore_decay: float = 0.99
+    memory_size: int = 5000        # gradient-replay capacity (train); 1000 test
+    ul_data: float = 100.0         # per-task uplink data size (Job defaults)
+    dl_data: float = 1.0           # per-task downlink data size
+
+    # ---- model ------------------------------------------------------------
+    hidden: int = 32
+    cheb_k: int = 1                # Chebyshev order; 1 reproduces the shipped
+    #                                reference checkpoints (SURVEY.md §2.3);
+    #                                >=2 enables the real spectral GNN.
+    leaky_relu_alpha: float = 0.2  # keras LeakyReLU default negative slope
+    max_norm: float = 1.0          # per-column kernel/bias max-norm constraint
+    clipnorm: float = 1.0          # Adam global-norm gradient clip
+
+    # ---- TPU-native knobs -------------------------------------------------
+    dtype: str = "float32"         # computation dtype ("float64" for parity)
+    instance_batch: int = 16       # vmap width (instances per device)
+    pad_nodes: Optional[int] = None    # None = derive from data (next multiple)
+    pad_links: Optional[int] = None
+    pad_ext: Optional[int] = None
+    pad_jobs: Optional[int] = None
+    pad_servers: Optional[int] = None
+    round_to: int = 8              # pad sizes up to a multiple of this
+    seed: int = 0                  # workload RNG (reference is unseeded)
+    mesh_data: int = 1             # data-parallel mesh axis size
+    mesh_graph: int = 1            # graph-partition (ring APSP) axis size
+
+    @property
+    def jnp_dtype(self):
+        import jax.numpy as jnp
+
+        return {"float32": jnp.float32, "float64": jnp.float64,
+                "bfloat16": jnp.bfloat16}[self.dtype]
+
+    def model_dir(self, root: str = "model") -> str:
+        """Checkpoint directory; naming mirrors `AdHoc_train.py:59`."""
+        import os
+
+        return os.path.join(
+            root,
+            "model_ChebConv_{}_a{}_c{}_ACO_agent".format(
+                self.training_set, self.num_layer, self.num_layer
+            ),
+        )
+
+
+def _add_bool(parser: argparse.ArgumentParser, name: str, default: bool, help_: str):
+    parser.add_argument(
+        f"--{name}", type=lambda s: s.lower() in ("1", "true", "yes"),
+        default=default, help=help_,
+    )
+
+
+def build_parser(defaults: Optional[Config] = None) -> argparse.ArgumentParser:
+    cfg = defaults or Config()
+    p = argparse.ArgumentParser(description=__doc__)
+    for f in dataclasses.fields(Config):
+        d = getattr(cfg, f.name)
+        if f.type == "bool" or isinstance(d, bool):
+            _add_bool(p, f.name, d, f.name)
+        elif d is None:
+            p.add_argument(f"--{f.name}", type=int, default=None)
+        else:
+            p.add_argument(f"--{f.name}", type=type(d), default=d)
+    return p
+
+
+def from_args(argv=None, defaults: Optional[Config] = None) -> Config:
+    ns = build_parser(defaults).parse_args(argv)
+    return Config(**vars(ns))

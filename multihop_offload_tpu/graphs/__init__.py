@@ -1,0 +1,21 @@
+from multihop_offload_tpu.graphs.generators import (  # noqa: F401
+    barabasi_albert,
+    erdos_renyi,
+    gaussian_random_partition,
+    poisson_disk,
+    watts_strogatz,
+    unit_disk_adjacency,
+)
+from multihop_offload_tpu.graphs.topology import Topology  # noqa: F401
+from multihop_offload_tpu.graphs.instance import (  # noqa: F401
+    Instance,
+    JobSet,
+    PadSpec,
+    build_instance,
+    stack_instances,
+)
+from multihop_offload_tpu.graphs.matio import (  # noqa: F401
+    load_case_mat,
+    save_case_mat,
+    CaseRecord,
+)

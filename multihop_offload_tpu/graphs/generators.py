@@ -1,0 +1,118 @@
+"""Host-side random-topology generators.
+
+Covers every graph family the reference environment can construct
+(`/root/reference/src/offloading_v3.py:39-57`): Barabási–Albert, Gaussian
+random partition, connected Watts–Strogatz, Erdős–Rényi, plus the Poisson
+unit-disk process of the dataset generator
+(`data_generation_offloading.py:34-50`).  Generation is cheap, irregular,
+host-only work — NumPy/NetworkX is the right tool; everything downstream of
+the returned dense adjacency is fixed-shape JAX.
+
+All generators return ``(adj, pos)`` with ``adj`` a dense ``(n, n)`` uint8
+symmetric 0/1 matrix with zero diagonal and ``pos`` an ``(n, 2)`` float array
+of node coordinates (or ``None`` when the family has no natural geometry).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import networkx as nx
+import numpy as np
+from scipy.spatial import distance_matrix
+
+
+def _to_adj(g: nx.Graph, n: int) -> np.ndarray:
+    adj = np.zeros((n, n), dtype=np.uint8)
+    for u, v in g.edges:
+        adj[u, v] = 1
+        adj[v, u] = 1
+    return adj
+
+
+def barabasi_albert(n: int, m: int = 2, seed: int = 0) -> Tuple[np.ndarray, None]:
+    """BA preferential attachment (reference `offloading_v3.py:39-40`)."""
+    return _to_adj(nx.barabasi_albert_graph(n, m, seed=seed), n), None
+
+
+def gaussian_random_partition(n: int, seed: int = 0) -> Tuple[np.ndarray, None]:
+    """GRP(n, 15, 3, 0.4, 0.2) (reference `offloading_v3.py:41-42`)."""
+    g = nx.gaussian_random_partition_graph(n, 15, 3, 0.4, 0.2, seed=seed)
+    return _to_adj(g, n), None
+
+
+def watts_strogatz(n: int, k: int = 6, p: float = 0.2, seed: int = 0) -> Tuple[np.ndarray, None]:
+    """Connected WS(k=6, p=0.2) (reference `offloading_v3.py:43-44`)."""
+    g = nx.connected_watts_strogatz_graph(n, k=k, p=p, seed=seed)
+    return _to_adj(g, n), None
+
+
+def erdos_renyi(n: int, seed: int = 0) -> Tuple[np.ndarray, None]:
+    """ER with expected degree 15 (reference `offloading_v3.py:45-46`)."""
+    g = nx.fast_gnp_random_graph(n, 15.0 / float(n), seed=seed)
+    return _to_adj(g, n), None
+
+
+def unit_disk_adjacency(pos: np.ndarray, radius: float = 1.0) -> np.ndarray:
+    """Adjacency of a unit-disk graph over 2-D points.
+
+    Same rule as the reference's mobility model (`offloading_v3.py:90-93`)
+    and Poisson generator (`data_generation_offloading.py:45-48`).
+    """
+    n = pos.shape[0]
+    d = distance_matrix(pos, pos)
+    adj = (d <= radius).astype(np.uint8)
+    np.fill_diagonal(adj, 0)
+    return adj
+
+
+def poisson_disk(
+    n: int, nb: float = 4.0, radius: float = 1.0, seed: Optional[int] = None
+) -> Tuple[np.ndarray, np.ndarray]:
+    """2-D Poisson point process with expected `nb` neighbors in unit radius.
+
+    Mirrors `data_generation_offloading.py:34-50`: points uniform on a square
+    sized so the point density is nb/pi per unit area.
+    """
+    rng = np.random.default_rng(seed)
+    density = float(nb) / np.pi
+    side = np.sqrt(float(n) / density)
+    pos = rng.uniform(0, side, (int(n), 2))
+    return unit_disk_adjacency(pos, radius), pos
+
+
+def connected_poisson_disk(
+    n: int, seed: Optional[int] = None, nb_start: float = 4.0
+) -> Tuple[np.ndarray, np.ndarray, float]:
+    """Increase density until the Poisson graph is connected
+    (`data_generation_offloading.py:61-67`)."""
+    nb = nb_start - 1
+    while True:
+        nb += 1
+        adj, pos = poisson_disk(n, nb=nb, seed=seed)
+        if nx.is_connected(nx.from_numpy_array(adj)):
+            return adj, pos, nb
+
+
+GENERATORS = {
+    "ba": lambda n, seed, m=2: barabasi_albert(n, m=m, seed=seed),
+    "grp": lambda n, seed, m=2: gaussian_random_partition(n, seed=seed),
+    "ws": lambda n, seed, m=2: watts_strogatz(n, seed=seed),
+    "er": lambda n, seed, m=2: erdos_renyi(n, seed=seed),
+    "poisson": lambda n, seed, m=2: poisson_disk(n, nb=m, seed=seed),
+}
+
+
+def generate(gtype: str, n: int, seed: int, m: int = 2):
+    """Dispatch on graph-family name (reference `offloading_v3.py:39-59`)."""
+    gtype = gtype.lower()
+    if gtype not in GENERATORS:
+        raise ValueError(f"unsupported graph model '{gtype}'")
+    return GENERATORS[gtype](n, seed, m=m)
+
+
+def spring_positions(adj: np.ndarray, seed: Optional[int] = None) -> np.ndarray:
+    """Spring layout for plotting (reference `offloading_v3.py:156,163`)."""
+    g = nx.from_numpy_array(adj)
+    pos = nx.spring_layout(g, seed=seed)
+    return np.stack([pos[i] for i in range(adj.shape[0])])

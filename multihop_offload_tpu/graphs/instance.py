@@ -1,0 +1,220 @@
+"""Padded, fixed-shape device representation of a network instance.
+
+The reference passes NetworkX objects and Python lists between every stage
+(`offloading_v3.py`, `gnn_offloading_agent.py`); under XLA everything must be
+a static-shape array.  `Instance` freezes one network (topology + roles +
+capacities) into padded arrays; `JobSet` holds a padded workload.  Both are
+pytrees, so a batch of instances is just the same structure with a leading
+axis (`stack_instances`) and every environment kernel is written per-instance
+and `vmap`'d.
+
+Extended-line-graph layout (replaces `graph_expand`, `offloading_v3.py:262-339`):
+slot ``e in [0, L)`` is real link ``e``; slot ``L + i`` is node ``i``'s
+pseudo-link ("compute here", the reference's `(i, n+i)` edge).  This makes the
+reference's `maps_ol_el` the identity and `maps_on_el[i] = L + i`, removing
+every dynamic `list.index` lookup from the hot path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import numpy as np
+from flax import struct
+
+from multihop_offload_tpu.graphs.topology import Topology
+
+
+@dataclasses.dataclass(frozen=True)
+class PadSpec:
+    """Static pad sizes. E (extended slots) is always L + N by construction."""
+
+    n: int          # nodes
+    l: int          # links
+    s: int          # servers
+    j: int          # jobs
+
+    @property
+    def e(self) -> int:
+        return self.l + self.n
+
+    @staticmethod
+    def round_up(x: int, to: int) -> int:
+        return int(-(-x // to) * to)
+
+    @classmethod
+    def for_cases(cls, sizes: Sequence[tuple], round_to: int = 8) -> "PadSpec":
+        """sizes: iterable of (n, l, s, j) actual sizes."""
+        arr = np.asarray(list(sizes), dtype=np.int64)
+        n, l, s, j = (int(arr[:, k].max()) for k in range(4))
+        r = lambda v: cls.round_up(max(v, 1), round_to)
+        return cls(n=r(n), l=r(l), s=r(s), j=r(j))
+
+
+@struct.dataclass
+class Instance:
+    """One padded network. All arrays fixed-shape; float dtype configurable."""
+
+    # nodes
+    adj: np.ndarray          # (N, N) float 0/1 connectivity
+    node_mask: np.ndarray    # (N,) bool — real node
+    roles: np.ndarray        # (N,) int32: 0 mobile / 1 server / 2 relay (pad=2)
+    proc_bws: np.ndarray     # (N,) float processing bandwidth (relay/pad = 0)
+    comp_mask: np.ndarray    # (N,) bool — node can compute (roles < 2, real)
+    # links (canonical order; pad links have rate 1, zero conflict rows)
+    link_ends: np.ndarray    # (L, 2) int32
+    link_rates: np.ndarray   # (L,) float
+    link_mask: np.ndarray    # (L,) bool
+    link_index: np.ndarray   # (N, N) int32 edge -> link id (0 where no edge)
+    adj_conflict: np.ndarray  # (L, L) float conflict-graph adjacency
+    cf_degs: np.ndarray      # (L,) float conflict degrees
+    # extended line graph (E = L + N slots)
+    adj_ext: np.ndarray      # (E, E) float extended-line-graph adjacency
+    ext_rate: np.ndarray     # (E,) float: link rate / node proc_bw
+    ext_self_loop: np.ndarray  # (E,) float 1.0 on active pseudo-link slots
+    ext_as_server: np.ndarray  # (E,) float 1.0 on server pseudo-links
+    ext_mask: np.ndarray     # (E,) bool
+    # servers, ascending node index (reference add-order, AdHoc_train.py:104-110)
+    servers: np.ndarray      # (S,) int32 (pad = 0)
+    server_mask: np.ndarray  # (S,) bool
+    # scalars
+    T: np.ndarray            # () float congestion-penalty scale
+
+    @property
+    def num_pad_nodes(self) -> int:
+        return self.adj.shape[-1]
+
+    @property
+    def num_pad_links(self) -> int:
+        return self.link_rates.shape[-1]
+
+
+@struct.dataclass
+class JobSet:
+    """Padded workload: one compute task stream per slot
+    (reference `Job`, `offloading_v3.py:131-138`)."""
+
+    src: np.ndarray    # (J,) int32 source node (pad = 0)
+    rate: np.ndarray   # (J,) float arrival rate (pad = 0)
+    ul: np.ndarray     # (J,) float uplink data size
+    dl: np.ndarray     # (J,) float downlink data size
+    mask: np.ndarray   # (J,) bool
+
+    @property
+    def num_jobs(self):
+        return self.mask.sum()
+
+
+def build_instance(
+    topo: Topology,
+    roles: np.ndarray,
+    proc_bws: np.ndarray,
+    link_rates: np.ndarray,
+    t_max: float,
+    pad: PadSpec,
+    dtype=np.float32,
+) -> Instance:
+    """Freeze a topology + resource assignment into a padded Instance."""
+    n, l = topo.n, topo.num_links
+    N, L, S = pad.n, pad.l, pad.s
+    if n > N or l > L:
+        raise ValueError(f"case ({n} nodes, {l} links) exceeds pad ({N}, {L})")
+
+    roles = np.asarray(roles, dtype=np.int32)
+    proc_bws = np.asarray(proc_bws, dtype=dtype)
+    link_rates = np.asarray(link_rates, dtype=dtype)
+
+    adj = np.zeros((N, N), dtype=dtype)
+    adj[:n, :n] = topo.adj
+    node_mask = np.zeros((N,), dtype=bool)
+    node_mask[:n] = True
+    roles_p = np.full((N,), 2, dtype=np.int32)
+    roles_p[:n] = roles
+    bws_p = np.zeros((N,), dtype=dtype)
+    bws_p[:n] = proc_bws
+    comp_mask = (roles_p < 2) & node_mask
+
+    ends_p = np.zeros((L, 2), dtype=np.int32)
+    ends_p[:l] = topo.link_ends
+    rates_p = np.ones((L,), dtype=dtype)  # pad rate 1 avoids 0/0 in the FP
+    rates_p[:l] = link_rates
+    link_mask = np.zeros((L,), dtype=bool)
+    link_mask[:l] = True
+    link_index = np.zeros((N, N), dtype=np.int32)
+    link_index[:n, :n] = np.maximum(topo.link_index, 0)
+    adj_cf = np.zeros((L, L), dtype=dtype)
+    adj_cf[:l, :l] = topo.adj_conflict
+    cf_degs = np.zeros((L,), dtype=dtype)
+    cf_degs[:l] = topo.cf_degs
+
+    # extended line graph: [0, L) real links, [L, L + N) pseudo-links
+    E = pad.e
+    ext_mask = np.concatenate([link_mask, comp_mask])
+    ext_rate = np.concatenate([rates_p, bws_p]).astype(dtype)
+    ext_self_loop = np.concatenate(
+        [np.zeros((L,)), comp_mask.astype(np.float64)]
+    ).astype(dtype)
+    ext_as_server = np.zeros((E,), dtype=dtype)
+    ext_as_server[L:][roles_p == 1] = 1.0  # reference `edge_as_server`, :317-326
+    adj_ext = np.zeros((E, E), dtype=dtype)
+    adj_ext[:L, :L][:l, :l] = topo.adj_lg  # pure line graph (not conflict-aug.)
+    inc = np.zeros((L, N), dtype=dtype)    # link-node incidence, masked
+    inc[np.arange(l), topo.link_ends[:, 0]] = 1.0
+    inc[np.arange(l), topo.link_ends[:, 1]] = 1.0
+    inc *= comp_mask[None, :].astype(dtype)
+    adj_ext[:L, L:] = inc
+    adj_ext[L:, :L] = inc.T
+
+    server_ids = np.flatnonzero(roles_p == 1)
+    if server_ids.size > S:
+        raise ValueError(f"{server_ids.size} servers exceed pad {S}")
+    servers = np.zeros((S,), dtype=np.int32)
+    servers[: server_ids.size] = np.sort(server_ids)
+    server_mask = np.zeros((S,), dtype=bool)
+    server_mask[: server_ids.size] = True
+
+    return Instance(
+        adj=adj, node_mask=node_mask, roles=roles_p, proc_bws=bws_p,
+        comp_mask=comp_mask, link_ends=ends_p, link_rates=rates_p,
+        link_mask=link_mask, link_index=link_index, adj_conflict=adj_cf,
+        cf_degs=cf_degs, adj_ext=adj_ext, ext_rate=ext_rate,
+        ext_self_loop=ext_self_loop, ext_as_server=ext_as_server,
+        ext_mask=ext_mask, servers=servers, server_mask=server_mask,
+        T=np.asarray(t_max, dtype=dtype),
+    )
+
+
+def build_jobset(
+    src: np.ndarray,
+    rate: np.ndarray,
+    pad_jobs: int,
+    ul: float = 100.0,
+    dl: float = 1.0,
+    dtype=np.float32,
+) -> JobSet:
+    """Pad a concrete workload (job defaults from `offloading_v3.py:132`)."""
+    src = np.asarray(src, dtype=np.int32)
+    rate = np.asarray(rate, dtype=dtype)
+    j = src.shape[0]
+    J = pad_jobs
+    if j > J:
+        raise ValueError(f"{j} jobs exceed pad {J}")
+    src_p = np.zeros((J,), dtype=np.int32)
+    src_p[:j] = src
+    rate_p = np.zeros((J,), dtype=dtype)
+    rate_p[:j] = rate
+    mask = np.zeros((J,), dtype=bool)
+    mask[:j] = True
+    return JobSet(
+        src=src_p, rate=rate_p,
+        ul=np.full((J,), ul, dtype=dtype), dl=np.full((J,), dl, dtype=dtype),
+        mask=mask,
+    )
+
+
+def stack_instances(items: Sequence):
+    """Stack same-shape pytrees into a batched pytree (the vmap axis)."""
+    import jax
+
+    return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *items)

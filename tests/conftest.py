@@ -1,0 +1,40 @@
+"""Test harness: run JAX on a virtual 8-device CPU mesh with float64 enabled.
+
+Multi-chip sharding tests run here without TPU hardware
+(`--xla_force_host_platform_device_count=8`); float64 lets oracle comparisons
+be exact against NumPy references.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+REFERENCE_DATA = "/root/reference/data/aco_data_ba_10"
+REFERENCE_CKPT = "/root/reference/model/model_ChebConv_BAT800_a5_c5_ACO_agent"
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(1234)
+
+
+@pytest.fixture(scope="session")
+def small_cases():
+    """A handful of real reference cases (smoke-test dataset), if present."""
+    import multihop_offload_tpu.graphs.matio as matio
+
+    if not os.path.isdir(REFERENCE_DATA):
+        pytest.skip("reference dataset unavailable")
+    names = matio.list_dataset(REFERENCE_DATA)
+    picks = [n for n in names if "_n20_" in n][:2] + [n for n in names if "_n40_" in n][:1]
+    return [matio.load_case_mat(os.path.join(REFERENCE_DATA, n)) for n in picks]

@@ -1,0 +1,148 @@
+"""Graph layer: topology arrays vs NetworkX oracles, padding, .mat IO."""
+
+import os
+
+import networkx as nx
+import numpy as np
+import pytest
+
+from multihop_offload_tpu.graphs import generators
+from multihop_offload_tpu.graphs.instance import PadSpec, build_instance, build_jobset
+from multihop_offload_tpu.graphs.matio import (
+    load_case_mat,
+    reference_link_order,
+    save_case_mat,
+)
+from multihop_offload_tpu.graphs.topology import build_topology, sample_link_rates
+
+
+def _random_topo(seed, n=25, m=2):
+    adj, _ = generators.barabasi_albert(n, m=m, seed=seed)
+    return build_topology(adj)
+
+
+@pytest.mark.parametrize("seed", [0, 7])
+def test_line_graph_matches_networkx(seed):
+    topo = _random_topo(seed)
+    g = nx.from_numpy_array(topo.adj)
+    lg = nx.line_graph(g)
+    # same number of links and conflict edges
+    assert topo.num_links == lg.number_of_nodes()
+    assert int(topo.adj_lg.sum()) // 2 == lg.number_of_edges()
+    # adjacency agrees link-by-link under the canonical indexing
+    for (a, b), (c, d) in lg.edges:
+        i = topo.link_index[a, b]
+        j = topo.link_index[c, d]
+        assert topo.adj_lg[i, j] == 1 and topo.adj_lg[j, i] == 1
+    # conflict degrees equal line-graph degrees when cf_radius == 0
+    for (a, b), deg in lg.degree:
+        assert topo.cf_degs[topo.link_index[a, b]] == deg
+
+
+def test_link_index_symmetric_and_complete():
+    topo = _random_topo(3)
+    iu, ju = np.nonzero(np.triu(topo.adj, 1))
+    for u, v in zip(iu, ju):
+        li = topo.link_index[u, v]
+        assert li == topo.link_index[v, u] >= 0
+        assert tuple(topo.link_ends[li]) == (u, v)
+    assert (topo.link_index[topo.adj == 0] == -1).all()
+
+
+def test_connected_flag_matches_networkx():
+    topo = _random_topo(1)
+    assert topo.connected == nx.is_connected(nx.from_numpy_array(topo.adj))
+    # two disconnected triangles
+    adj = np.zeros((6, 6), dtype=np.uint8)
+    for a, b in [(0, 1), (1, 2), (0, 2), (3, 4), (4, 5), (3, 5)]:
+        adj[a, b] = adj[b, a] = 1
+    assert not build_topology(adj).connected
+
+
+def test_cf_radius_adds_conflicts():
+    adj, pos = generators.poisson_disk(30, nb=5, seed=5)
+    t0 = build_topology(adj, pos=pos, cf_radius=0.0)
+    t2 = build_topology(adj, pos=pos, cf_radius=2.0)
+    assert t2.adj_conflict.sum() >= t0.adj_conflict.sum()
+    assert (t2.adj_conflict >= t2.adj_lg).all()
+    assert (np.diag(t2.adj_conflict) == 0).all()
+    assert (t2.adj_conflict == t2.adj_conflict.T).all()
+
+
+def test_sample_link_rates_bounds(rng):
+    topo = _random_topo(2)
+    base = rng.uniform(30, 70, topo.num_links)
+    rates = sample_link_rates(topo, base, std=2.0, rng=rng)
+    assert rates.shape == (topo.num_links,)
+    assert (rates >= 0).all() and (rates <= base + 6).all()
+    assert (rates == np.round(rates)).all()
+
+
+def test_instance_padding_and_ext_layout(rng):
+    topo = _random_topo(4, n=20)
+    n, l = topo.n, topo.num_links
+    roles = np.zeros(n, dtype=np.int32)
+    roles[[1, 5]] = 1  # servers
+    roles[[2]] = 2     # relay
+    bws = np.where(roles == 1, 100.0, np.where(roles == 2, 0.0, 8.0))
+    rates = sample_link_rates(topo, 50.0, rng=rng)
+    pad = PadSpec(n=24, l=48, s=4, j=16)
+    inst = build_instance(topo, roles, bws, rates, 1000.0, pad, dtype=np.float64)
+
+    assert inst.adj.shape == (24, 24) and inst.adj_ext.shape == (72, 72)
+    assert inst.node_mask.sum() == n and inst.link_mask.sum() == l
+    # servers ascending with mask
+    assert list(inst.servers[:2]) == [1, 5] and inst.server_mask.sum() == 2
+    # pseudo-link slots: rate = proc_bw, flags aligned
+    assert np.allclose(inst.ext_rate[pad.l : pad.l + n], bws)
+    assert inst.ext_self_loop[pad.l + 2] == 0  # relay has no pseudo-link
+    assert inst.ext_as_server[pad.l + 1] == 1
+    assert inst.ext_mask.sum() == l + (n - 1)  # one relay
+    # ext adjacency: real link slot <-> pseudo slot of its endpoints (non-relay)
+    u, v = topo.link_ends[0]
+    assert inst.adj_ext[0, pad.l + u] == (1.0 if roles[u] != 2 else 0.0)
+    # pad link rows are inert
+    assert (inst.adj_conflict[l:, :] == 0).all()
+    assert (inst.link_rates[l:] == 1.0).all()
+
+
+def test_jobset_padding():
+    js = build_jobset([3, 4], [0.1, 0.2], pad_jobs=8, dtype=np.float64)
+    assert js.mask.sum() == 2 and js.rate[2:].sum() == 0
+    assert js.ul[0] == 100.0 and js.dl[0] == 1.0
+
+
+def test_mat_roundtrip(tmp_path, rng):
+    adj, pos = generators.barabasi_albert(20, seed=11)
+    pos = generators.spring_positions(adj, seed=0)
+    topo = build_topology(adj)
+    rates = rng.uniform(30, 70, topo.num_links)
+    nodes_info = np.zeros((20, 2), dtype=np.int64)
+    nodes_info[:, 1] = 8
+    nodes_info[0] = [1, 200]
+    p = str(tmp_path / "case.mat")
+    save_case_mat(p, adj, rates, nodes_info, pos, seed=11, m=2, gtype="ba")
+    rec = load_case_mat(p)
+    assert rec.topo.n == 20 and rec.seed == 11
+    assert np.allclose(rec.link_rates, rates)  # canonical order round-trips
+    assert rec.num_servers == 1 and (rec.roles == nodes_info[:, 0]).all()
+
+
+def test_load_reference_cases(small_cases):
+    for rec in small_cases:
+        assert rec.topo.connected
+        assert rec.link_rates.shape[0] == rec.topo.num_links
+        assert (rec.link_rates >= 30 - 1e-9).all() and (rec.link_rates <= 70 + 1e-9).all()
+        assert rec.num_servers > 0 and rec.mobile_nodes.size > 0
+        # reference order permutation is a bijection
+        perm = reference_link_order(rec.topo.adj)
+        assert np.sort(perm).tolist() == list(range(rec.topo.num_links))
+
+
+def test_generators_shapes():
+    for name in ["ba", "grp", "ws", "er", "poisson"]:
+        adj, pos = generators.generate(name, 30, seed=2)
+        assert adj.shape == (30, 30)
+        assert (adj == adj.T).all() and (np.diag(adj) == 0).all()
+    adj, pos, nb = generators.connected_poisson_disk(25, seed=3)
+    assert nx.is_connected(nx.from_numpy_array(adj))
