@@ -1,0 +1,20 @@
+from multihop_offload_tpu.env.apsp import (  # noqa: F401
+    apsp_minplus,
+    hop_matrix,
+    next_hop_table,
+    weight_matrix_from_link_delays,
+)
+from multihop_offload_tpu.env.routing import trace_routes, RouteSet  # noqa: F401
+from multihop_offload_tpu.env.offloading import offload_decide, OffloadDecision  # noqa: F401
+from multihop_offload_tpu.env.queueing import (  # noqa: F401
+    interference_fixed_point,
+    run_empirical,
+    EmpiricalDelays,
+)
+from multihop_offload_tpu.env.baseline import baseline_unit_delays  # noqa: F401
+from multihop_offload_tpu.env.policies import (  # noqa: F401
+    baseline_policy,
+    local_policy,
+    evaluate_spmatrix_policy,
+    PolicyOutcome,
+)

@@ -1,0 +1,75 @@
+"""All-pairs shortest paths as dense min-plus linear algebra.
+
+The reference's hottest host routine is per-source Dijkstra over NetworkX
+(`util.py:101-110`, 2-4 calls per instance per method).  On TPU the graphs are
+tiny (N <= ~110) and dense O(N^3) min-plus matrix squaring is both exact and
+a perfectly tiled XLA computation: ceil(log2(N-1)) squarings reach every
+simple path.  Weights are nonnegative (delays), so min-plus squaring equals
+Dijkstra distances.
+
+Also provides the greedy next-hop table: `next_hop[u, d]` = the neighbor of u
+minimizing `sp[v, d]`, lowest index on ties — exactly the reference's
+distributed forwarding rule (`offloading_v3.py:441-453`, `np.argmin` over the
+ascending neighbor list).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _minplus_square(d: jnp.ndarray) -> jnp.ndarray:
+    """One squaring step: d[i,j] <- min_k d[i,k] + d[k,j] (and keep d)."""
+    return jnp.minimum(d, jnp.min(d[:, :, None] + d[None, :, :], axis=1))
+
+
+def apsp_minplus(weights: jnp.ndarray, num_iters: int | None = None) -> jnp.ndarray:
+    """Shortest-path distance matrix from a one-hop weight matrix.
+
+    `weights`: (N, N), w[u,v] = edge weight (inf where no edge), any diagonal
+    (it is forced to 0).  Returns distances with zero diagonal.
+    """
+    n = weights.shape[-1]
+    d = jnp.where(jnp.eye(n, dtype=bool), jnp.zeros_like(weights), weights)
+    iters = num_iters if num_iters is not None else max(1, math.ceil(math.log2(max(n - 1, 2))))
+    return lax.fori_loop(0, iters, lambda _, x: _minplus_square(x), d)
+
+
+def hop_matrix(adj: jnp.ndarray) -> jnp.ndarray:
+    """Unweighted shortest-path hop counts (reference `sp_hop`,
+    `AdHoc_train.py:135`)."""
+    w = jnp.where(adj > 0, jnp.ones_like(adj), jnp.full_like(adj, jnp.inf))
+    return apsp_minplus(w)
+
+
+def weight_matrix_from_link_delays(
+    adj: jnp.ndarray, link_index: jnp.ndarray, link_delays: jnp.ndarray
+) -> jnp.ndarray:
+    """Scatter per-link delays into an (N, N) one-hop weight matrix.
+
+    Replaces the reference's per-edge attribute writes + Dijkstra
+    (`gnn_offloading_agent.py:281-287`).  Non-edges get +inf.
+    """
+    gathered = link_delays[link_index]  # (N, N), garbage where no edge
+    return jnp.where(adj > 0, gathered, jnp.full_like(gathered, jnp.inf))
+
+
+def next_hop_table(adj: jnp.ndarray, sp: jnp.ndarray) -> jnp.ndarray:
+    """next_hop[u, d]: neighbor v of u minimizing sp[v, d] (ties -> lowest v).
+
+    Greedy shortest-path forwarding (`offloading_v3.py:447-451`): because the
+    reference enumerates neighbors with `np.nonzero` (ascending) and takes the
+    first argmin, a plain masked argmin over the full vertex set reproduces
+    its tie-breaking exactly.
+    """
+    # cost[u, v, d] = sp[v, d] if (u,v) is an edge else +inf
+    cost = jnp.where(
+        (adj > 0)[:, :, None],
+        jnp.broadcast_to(sp[None, :, :], adj.shape[:1] + sp.shape),
+        jnp.inf,
+    )
+    return jnp.argmin(cost, axis=1).astype(jnp.int32)

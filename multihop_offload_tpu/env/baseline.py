@@ -1,0 +1,26 @@
+"""Congestion-agnostic baseline unit delays.
+
+Reimplements `dmtx_baseline` (`offloading_v3.py:341-361`): per-link unit delay
+1/rate, per-node unit processing delay 1/proc_bw (inf for relays, whose
+proc_bw is 0 — making them transparent transit nodes that never attract
+compute).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from multihop_offload_tpu.graphs.instance import Instance
+
+
+def baseline_unit_delays(inst: Instance):
+    """Returns (link_delays (L,), node_delays (N,)).
+
+    The drivers replace non-positive node delays with T
+    (`AdHoc_train.py:129`); with nonnegative capacities 1/bw is never
+    negative, and relays' 1/0 = +inf already excludes them, so the
+    replacement is a no-op we do not replicate.
+    """
+    link = 1.0 / inst.link_rates          # inf on zero-capacity links
+    node = 1.0 / inst.proc_bws            # inf on relays / padding
+    return link, node

@@ -1,0 +1,93 @@
+"""The distributed greedy offloading decision as masked vector math.
+
+Reimplements `AdhocCloud.offloading` (`offloading_v3.py:388-439`): each job
+compares computing locally against every server (uplink SP delay x data +
+downlink SP delay x data + server processing delay, each lower-bounded by hop
+count / 1) and picks the argmin, with epsilon-greedy uniform exploration or
+softmax sampling.  The per-job Python loop becomes one (J, S+1) cost matrix;
+`jnp.argmin` reproduces NumPy's first-minimum tie-breaking because the padded
+server list is ascending.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from multihop_offload_tpu.graphs.instance import Instance, JobSet
+
+
+@struct.dataclass
+class OffloadDecision:
+    dst: jnp.ndarray        # (J,) int32 chosen compute node (src when local)
+    is_local: jnp.ndarray   # (J,) bool
+    delay_est: jnp.ndarray  # (J,) float predicted delay of the chosen option
+    costs: jnp.ndarray      # (J, S+1) full cost table (inf on padded servers)
+
+
+def offload_decide(
+    inst: Instance,
+    jobs: JobSet,
+    sp: jnp.ndarray,
+    hop: jnp.ndarray,
+    unit_diag: jnp.ndarray,
+    key: jax.Array,
+    explore: float | jnp.ndarray = 0.0,
+    prob: bool = False,
+) -> OffloadDecision:
+    """Choose a compute destination per job.
+
+    `sp`/`hop`: (N, N) shortest-path delay / hop matrices with zero diagonal
+    (the reference zeroes the diagonal before use, `offloading_v3.py:396-397`).
+    `unit_diag`: (N,) per-node unit processing delays — the diagonal the
+    caller would have written into the SP matrix (`:395`).
+    """
+    servers = inst.servers                       # (S,) ascending
+    smask = inst.server_mask
+    src = jobs.src
+
+    local_delay = unit_diag[src] * jobs.ul                       # (J,)
+    ul = sp[src[:, None], servers[None, :]] * jobs.ul[:, None]   # (J, S)
+    dl = sp[servers[None, :], src[:, None]] * jobs.dl[:, None]
+    proc = unit_diag[servers][None, :] * jobs.ul[:, None]
+    # lower bounds: hop counts for transport, 1 for processing (:411-413)
+    ul = jnp.maximum(ul, hop[src[:, None], servers[None, :]])
+    dl = jnp.maximum(dl, hop[servers[None, :], src[:, None]])
+    proc = jnp.maximum(proc, 1.0)
+    server_delays = ul + dl + proc                               # (J, S)
+
+    inf = jnp.array(jnp.inf, dtype=server_delays.dtype)
+    server_delays = jnp.where(smask[None, :], server_delays, inf)
+    costs = jnp.concatenate([server_delays, local_delay[:, None]], axis=1)
+
+    num_jobs = src.shape[0]
+    k_expl, k_pick, k_prob = jax.random.split(key, 3)
+    valid = jnp.concatenate(
+        [smask, jnp.ones((1,), dtype=bool)]
+    )[None, :].repeat(num_jobs, axis=0)                          # (J, S+1)
+
+    greedy = jnp.argmin(costs, axis=1)
+    if prob:
+        # softmax over raw costs (reference `util.softmax` over costs, :420-422
+        # — note: *higher* cost => higher probability, kept verbatim)
+        logits = jnp.where(valid, costs, -inf)
+        chosen = jax.random.categorical(k_prob, logits, axis=1)
+        base = chosen
+    else:
+        base = greedy
+    # epsilon-greedy: uniform over the valid options incl. local (:416-417)
+    uniform = jax.random.categorical(
+        k_pick, jnp.where(valid, 0.0, -inf), axis=1
+    )
+    do_explore = jax.random.uniform(k_expl, (num_jobs,)) < explore
+    jidx = jnp.where(do_explore, uniform, base).astype(jnp.int32)
+
+    num_slots = servers.shape[0]
+    is_local = jidx >= num_slots
+    dst = jnp.where(is_local, src, servers[jnp.clip(jidx, 0, num_slots - 1)])
+    delay_est = jnp.take_along_axis(costs, jidx[:, None], axis=1)[:, 0]
+    return OffloadDecision(
+        dst=dst.astype(jnp.int32), is_local=is_local,
+        delay_est=delay_est, costs=costs,
+    )

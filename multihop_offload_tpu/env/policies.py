@@ -1,0 +1,100 @@
+"""End-to-end policy evaluations: decision -> routing -> empirical delays.
+
+These compose the env kernels into the three non-learned methods the drivers
+benchmark on every instance (`AdHoc_train.py:124-157`): `baseline`
+(congestion-agnostic greedy offloading), `local` (compute at the source), and
+the generic "evaluate a unit-delay matrix" path that the GNN agent also uses.
+Each is a pure function of (Instance, JobSet, key) — jit/vmap-ready.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from multihop_offload_tpu.graphs.instance import Instance, JobSet
+from multihop_offload_tpu.env.apsp import (
+    apsp_minplus,
+    hop_matrix,
+    next_hop_table,
+    weight_matrix_from_link_delays,
+)
+from multihop_offload_tpu.env.baseline import baseline_unit_delays
+from multihop_offload_tpu.env.offloading import OffloadDecision, offload_decide
+from multihop_offload_tpu.env.queueing import EmpiricalDelays, run_empirical
+from multihop_offload_tpu.env.routing import RouteSet, trace_routes
+
+
+@struct.dataclass
+class PolicyOutcome:
+    decision: OffloadDecision
+    routes: RouteSet
+    delays: EmpiricalDelays
+
+    @property
+    def job_total(self):
+        return self.delays.job_total
+
+
+def evaluate_spmatrix_policy(
+    inst: Instance,
+    jobs: JobSet,
+    link_delays: jnp.ndarray,
+    unit_diag: jnp.ndarray,
+    key: jax.Array,
+    explore=0.0,
+    prob: bool = False,
+) -> PolicyOutcome:
+    """Offload + route + run given per-link unit delays and a node diagonal.
+
+    This is the shared skeleton of the baseline method
+    (`AdHoc_train.py:128-141`) and the GNN policy (`forward_env`,
+    `gnn_offloading_agent.py:278-291`): build the one-hop weight matrix, run
+    min-plus APSP + hop counts, take the greedy decision, trace routes, and
+    score empirically.
+    """
+    w = weight_matrix_from_link_delays(inst.adj, inst.link_index, link_delays)
+    sp = apsp_minplus(w)
+    hop = hop_matrix(inst.adj)
+    dec = offload_decide(inst, jobs, sp, hop, unit_diag, key, explore, prob)
+    nh = next_hop_table(inst.adj, sp)
+    routes = trace_routes(inst, nh, jobs, dec.dst)
+    delays = run_empirical(inst, jobs, routes)
+    return PolicyOutcome(decision=dec, routes=routes, delays=delays)
+
+
+def baseline_policy(
+    inst: Instance, jobs: JobSet, key: jax.Array, explore=0.0, prob: bool = False
+) -> PolicyOutcome:
+    """Congestion-agnostic greedy offloading (`AdHoc_train.py:128-141`)."""
+    link_d, node_d = baseline_unit_delays(inst)
+    return evaluate_spmatrix_policy(inst, jobs, link_d, node_d, key, explore, prob)
+
+
+def local_policy(inst: Instance, jobs: JobSet) -> PolicyOutcome:
+    """Everything computes at its source (`local_compute`,
+    `offloading_v3.py:363-386`)."""
+    _, node_d = baseline_unit_delays(inst)
+    num_jobs = jobs.src.shape[0]
+    dec = OffloadDecision(
+        dst=jobs.src,
+        is_local=jnp.ones((num_jobs,), bool),
+        delay_est=jnp.maximum(node_d[jobs.src] * jobs.ul, 1.0),
+        costs=jnp.zeros((num_jobs, inst.servers.shape[0] + 1), node_d.dtype),
+    )
+    # no links traversed: an identity "route" of zero hops
+    horizon = inst.num_pad_nodes
+    routes = RouteSet(
+        dst=jobs.src,
+        nhop=jnp.zeros((num_jobs,), node_d.dtype),
+        seq_slot=jnp.zeros((horizon, num_jobs), jnp.int32),
+        seq_active=jnp.zeros((horizon, num_jobs), bool),
+        inc_ext=jnp.zeros(
+            (inst.num_pad_links + inst.num_pad_nodes, num_jobs), node_d.dtype
+        ).at[inst.num_pad_links + jobs.src, jnp.arange(num_jobs)].add(
+            jobs.mask.astype(node_d.dtype)
+        ),
+    )
+    delays = run_empirical(inst, jobs, routes)
+    return PolicyOutcome(decision=dec, routes=routes, delays=delays)

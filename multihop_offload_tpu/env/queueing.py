@@ -1,0 +1,155 @@
+"""Contention-coupled M/M/1 queueing model — the empirical evaluator.
+
+Reimplements `AdhocCloud.run` (`offloading_v3.py:455-550`) as fixed-shape
+array math:
+
+1. per-link packet arrival rates accumulated over realized routes (a single
+   incidence @ rates matmul instead of the reference's per-flow route walk);
+2. a 10-iteration fixed point coupling link service rates through conflict-
+   graph busyness (`:500-506`) — one dense (L, L) matmul per iteration;
+3. per-(link, job) empirical delays `1/(mu - lambda)` with the congestion
+   fallback `T * lambda / ((ul + dl) * mu)` when `mu <= lambda` (`:537-542`),
+   and per-job server delays with their fallback (`:545-549`).
+
+Also emits the (N, N) empirical unit-delay matrix + written-entry mask the
+training MSE term supervises against (`:508,540-548`), with the reference's
+last-write-wins job ordering.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+from jax import lax
+
+from multihop_offload_tpu.graphs.instance import Instance, JobSet
+from multihop_offload_tpu.env.routing import RouteSet
+
+
+@struct.dataclass
+class EmpiricalDelays:
+    job_total: jnp.ndarray     # (J,) link + server delay per job (0 if padded)
+    job_link: jnp.ndarray      # (J,) transport component
+    job_server: jnp.ndarray    # (J,) compute component
+    congested: jnp.ndarray     # (J,) bool: total > T (real jobs only)
+    link_lambda: jnp.ndarray   # (L,) aggregate link arrival rates
+    link_mu: jnp.ndarray       # (L,) converged service rates
+    server_load: jnp.ndarray   # (N,) aggregate server arrival rates
+    unit_matrix: jnp.ndarray   # (N, N) empirical unit delays (0 where unwritten)
+    unit_mask: jnp.ndarray     # (N, N) bool: entry written by some flow
+
+
+def interference_fixed_point(
+    inst: Instance, link_lambda: jnp.ndarray, num_iters: int = 10
+) -> jnp.ndarray:
+    """Converged per-link service rates mu under conflict coupling.
+
+    mu_0 = rate / (cf_deg + 1); iterate: busy = clip(lambda/mu, 0, 1),
+    mu = rate / (1 + A_conflict @ busy)   (`offloading_v3.py:500-506`).
+    Shared by the empirical evaluator and both differentiable critics
+    (`gnn_offloading_agent.py:240-244`, `:348-352`).
+    """
+    mu0 = inst.link_rates / (inst.cf_degs + 1.0)
+
+    def body(_, mu):
+        busy = jnp.clip(link_lambda / mu, 0.0, 1.0)
+        neighbor_busy = inst.adj_conflict @ busy
+        return inst.link_rates / (1.0 + neighbor_busy)
+
+    return lax.fori_loop(0, num_iters, body, mu0)
+
+
+def run_empirical(
+    inst: Instance, jobs: JobSet, routes: RouteSet
+) -> EmpiricalDelays:
+    num_links = inst.num_pad_links
+    n = inst.num_pad_nodes
+    inc = routes.inc_ext[:num_links]              # (L, J)
+    jmask = jobs.mask
+    ul_rate = jobs.ul * jobs.rate
+    dl_rate = jobs.dl * jobs.rate
+
+    link_lambda = inc @ (ul_rate + dl_rate)       # (L,)  (`:494`)
+    server_load = jnp.zeros((n,), dtype=ul_rate.dtype).at[routes.dst].add(
+        jnp.where(jmask, ul_rate, 0.0)
+    )                                             # (`:496`)
+
+    link_mu = interference_fixed_point(inst, link_lambda)
+
+    # per-(link, job) unit delay with per-job congestion fallback (`:537-539`)
+    slack = link_mu - link_lambda                 # (L,)
+    congested_l = slack <= 0.0
+    safe_slack = jnp.where(congested_l, 1.0, slack)
+    unit_ok = 1.0 / safe_slack
+    unit_cong = inst.T * link_lambda[:, None] / (
+        (jobs.ul + jobs.dl)[None, :] * link_mu[:, None]
+    )
+    unit_lj = jnp.where(congested_l[:, None], unit_cong, unit_ok[:, None])
+
+    # per-link per-job empirical delay, only on traversed links (`:542`)
+    d_ul = jnp.maximum(jobs.ul[None, :] * unit_lj, routes.nhop[None, :])
+    d_dl = jnp.maximum(jobs.dl[None, :] * unit_lj, routes.nhop[None, :])
+    # untraversed (link, job) pairs may hold inf/NaN (e.g. zero-rate links the
+    # reference simply never visits) — mask before summing, don't multiply
+    job_link = jnp.sum(jnp.where(inc > 0, d_ul + d_dl, 0.0), axis=0)
+
+    # server component (`:545-549`)
+    bw = inst.proc_bws[routes.dst]
+    sload = server_load[routes.dst]
+    s_slack = bw - sload
+    s_cong = s_slack <= 0.0
+    unit_s = jnp.where(
+        s_cong,
+        inst.T * sload / (jobs.ul * jnp.where(bw > 0, bw, 1.0)),
+        1.0 / jnp.where(s_cong, 1.0, s_slack),
+    )
+    job_server = jnp.maximum(jobs.ul * unit_s, 1.0)
+
+    job_link = jnp.where(jmask, job_link, 0.0)
+    job_server = jnp.where(jmask, job_server, 0.0)
+    total = job_link + job_server
+
+    # ---- empirical unit-delay matrix, last-write-wins over job order -------
+    def write(carry, j):
+        u_link, u_node = carry
+        on_route = inc[:, j] > 0
+        u_link = jnp.where(on_route, unit_lj[:, j], u_link)
+        u_node = jnp.where(
+            jmask[j],
+            u_node.at[routes.dst[j]].set(unit_s[j]),
+            u_node,
+        )
+        return (u_link, u_node), None
+
+    (u_link, u_node), _ = lax.scan(
+        write,
+        (jnp.zeros((num_links,), total.dtype), jnp.zeros((n,), total.dtype)),
+        jnp.arange(jobs.src.shape[0]),
+    )
+    link_written = (inc @ jnp.where(jmask, 1.0, 0.0)) > 0
+    node_written = jnp.zeros((n,), bool).at[routes.dst].max(jmask)
+
+    u, v = inst.link_ends[:, 0], inst.link_ends[:, 1]
+    unit_matrix = jnp.zeros((n, n), total.dtype)
+    unit_matrix = unit_matrix.at[u, v].set(jnp.where(link_written, u_link, 0.0))
+    unit_matrix = unit_matrix.at[v, u].max(jnp.where(link_written, u_link, 0.0))
+    unit_matrix = unit_matrix.at[jnp.arange(n), jnp.arange(n)].set(
+        jnp.where(node_written, u_node, 0.0)
+    )
+    unit_mask = jnp.zeros((n, n), bool)
+    unit_mask = unit_mask.at[u, v].max(link_written)
+    unit_mask = unit_mask.at[v, u].max(link_written)
+    unit_mask = unit_mask.at[jnp.arange(n), jnp.arange(n)].max(node_written)
+
+    return EmpiricalDelays(
+        job_total=total,
+        job_link=job_link,
+        job_server=job_server,
+        congested=(total > inst.T) & jmask,
+        link_lambda=link_lambda,
+        link_mu=link_mu,
+        server_load=server_load,
+        unit_matrix=unit_matrix,
+        unit_mask=unit_mask,
+    )

@@ -1,0 +1,94 @@
+"""Route tracing as a fixed-length scan over the next-hop table.
+
+The reference walks each flow's route with a Python while-loop and O(L)
+`list.index` calls (`offloading_v3.py:441-453`, `:485-496`); here every job
+descends the next-hop table in lock-step inside one `lax.scan` of at most
+N-1 steps, emitting the visited extended-line-graph slot per step.  From that
+step sequence we build, with one scatter-add, the route incidence matrices
+the critic needs (`gnn_offloading_agent.py:310-331`).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+from jax import lax
+
+from multihop_offload_tpu.graphs.instance import Instance, JobSet
+
+
+@struct.dataclass
+class RouteSet:
+    """Realized routes for all jobs of one instance (the Flow records,
+    `offloading_v3.py:140-150`, in array form)."""
+
+    dst: jnp.ndarray         # (J,) int32 compute destination (== src if local)
+    nhop: jnp.ndarray        # (J,) float hop count of the uplink route
+    seq_slot: jnp.ndarray    # (H, J) int32 ext slot visited at each step
+    seq_active: jnp.ndarray  # (H, J) bool step is a real traversal
+    inc_ext: jnp.ndarray     # (E, J) 0/1 incidence incl. final pseudo-link
+    #                          (the critic's `routes` matrix); slots [0, L)
+    #                          are real links — slice with `link_incidence`.
+
+
+def trace_routes(
+    inst: Instance,
+    next_hop: jnp.ndarray,
+    jobs: JobSet,
+    dst: jnp.ndarray,
+) -> RouteSet:
+    """Walk every job's greedy route src -> dst simultaneously.
+
+    `next_hop`: (N, N) table from `env.apsp.next_hop_table`.  Local jobs
+    (dst == src) traverse no links.  Padded jobs contribute nothing (their
+    incidence column is zeroed by the job mask).
+    """
+    n = inst.num_pad_nodes
+    num_links = inst.num_pad_links
+    num_jobs = jobs.src.shape[0]
+    horizon = n  # a simple route visits < N nodes
+
+    def step(carry, _):
+        node, hops = carry
+        active = node != dst
+        nxt = next_hop[node, dst]
+        link = inst.link_index[node, nxt]          # valid only while active
+        node2 = jnp.where(active, nxt, node)
+        hops2 = hops + active.astype(hops.dtype)
+        return (node2, hops2), (link, active)
+
+    (final_node, nhop), (seq_link, seq_active) = lax.scan(
+        step,
+        (jobs.src, jnp.zeros((num_jobs,), dtype=inst.link_rates.dtype)),
+        None,
+        length=horizon,
+    )
+    # mask out padded jobs entirely
+    seq_active = seq_active & jobs.mask[None, :]
+    seq_slot = jnp.where(seq_active, seq_link, 0).astype(jnp.int32)
+
+    # incidence over extended slots: real links from the step sequence,
+    # then the compute pseudo-link at the destination for every real job
+    # (reference `routes_np`, gnn_offloading_agent.py:310-331).
+    cols = jnp.broadcast_to(jnp.arange(num_jobs)[None, :], seq_slot.shape)
+    inc = jnp.zeros(
+        (num_links + n, num_jobs), dtype=inst.link_rates.dtype
+    ).at[seq_slot.reshape(-1), cols.reshape(-1)].add(
+        seq_active.reshape(-1).astype(inst.link_rates.dtype)
+    )
+    pseudo = num_links + dst
+    inc = inc.at[pseudo, jnp.arange(num_jobs)].add(jobs.mask.astype(inc.dtype))
+
+    return RouteSet(
+        dst=dst,
+        nhop=jnp.where(jobs.mask, nhop, 0.0),
+        seq_slot=seq_slot,
+        seq_active=seq_active,
+        inc_ext=inc,
+    )
+
+
+def link_incidence(routes: RouteSet, num_links: int) -> jnp.ndarray:
+    """(L, J) real-link incidence slice of the extended incidence."""
+    return routes.inc_ext[:num_links]

@@ -174,7 +174,7 @@ def build_instance(
     server_mask = np.zeros((S,), dtype=bool)
     server_mask[: server_ids.size] = True
 
-    return Instance(
+    inst = Instance(
         adj=adj, node_mask=node_mask, roles=roles_p, proc_bws=bws_p,
         comp_mask=comp_mask, link_ends=ends_p, link_rates=rates_p,
         link_mask=link_mask, link_index=link_index, adj_conflict=adj_cf,
@@ -183,6 +183,7 @@ def build_instance(
         ext_mask=ext_mask, servers=servers, server_mask=server_mask,
         T=np.asarray(t_max, dtype=dtype),
     )
+    return to_device(inst)
 
 
 def build_jobset(
@@ -206,15 +207,24 @@ def build_jobset(
     rate_p[:j] = rate
     mask = np.zeros((J,), dtype=bool)
     mask[:j] = True
-    return JobSet(
+    return to_device(JobSet(
         src=src_p, rate=rate_p,
         ul=np.full((J,), ul, dtype=dtype), dl=np.full((J,), dl, dtype=dtype),
         mask=mask,
-    )
+    ))
+
+
+def to_device(tree):
+    """Convert every leaf to a jnp array (indexable under tracing)."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(jnp.asarray, tree)
 
 
 def stack_instances(items: Sequence):
     """Stack same-shape pytrees into a batched pytree (the vmap axis)."""
     import jax
+    import jax.numpy as jnp
 
-    return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *items)
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *items)

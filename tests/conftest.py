@@ -3,17 +3,22 @@
 Multi-chip sharding tests run here without TPU hardware
 (`--xla_force_host_platform_device_count=8`); float64 lets oracle comparisons
 be exact against NumPy references.
+
+Note: this machine's interpreter pre-registers a remote TPU backend via
+`sitecustomize` (jax is already imported when conftest runs), so selecting
+CPU must go through `jax.config.update("jax_platforms", ...)` — the
+JAX_PLATFORMS env var is captured before we get control.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 )
 
 import jax
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 import numpy as np  # noqa: E402

@@ -1,0 +1,176 @@
+"""NumPy/SciPy oracle of the reference environment semantics, for tests only.
+
+An independent re-statement (per-job Python loops, scipy Dijkstra) of the
+behavior specified by `/root/reference/src/offloading_v3.py` and the decision
+math of `gnn_offloading_agent.py`, used to certify the fixed-shape JAX
+kernels.  Operates on the framework's CaseRecord/array types, canonical link
+order, deterministic (explore=0) decisions.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from scipy.sparse import csr_matrix
+from scipy.sparse.csgraph import dijkstra
+
+
+def apsp_oracle(weight_mtx: np.ndarray) -> np.ndarray:
+    """All-pairs Dijkstra over an (N,N) one-hop weight matrix (inf = no edge)."""
+    n = weight_mtx.shape[0]
+    w = np.array(weight_mtx, dtype=np.float64)
+    np.fill_diagonal(w, 0.0)
+    mask = np.isfinite(w) & (w > 0)
+    g = csr_matrix((w[mask], np.nonzero(mask)), shape=(n, n))
+    d = dijkstra(g, directed=False)
+    np.fill_diagonal(d, 0.0)
+    return d
+
+
+def hop_oracle(adj: np.ndarray) -> np.ndarray:
+    w = np.where(adj > 0, 1.0, np.inf)
+    return apsp_oracle(w)
+
+
+def greedy_route(adj, sp, src, dst):
+    """Reference routing (`offloading_v3.py:441-453`): descend sp toward dst,
+    ties to the lowest-index neighbor."""
+    route = [src]
+    node = src
+    hops = 0
+    while node != dst:
+        nbs = np.flatnonzero(adj[node])
+        node = int(nbs[np.argmin(sp[nbs, dst])])
+        route.append(node)
+        hops += 1
+        assert hops <= adj.shape[0], "routing did not terminate"
+    return route, hops
+
+
+def offload_oracle(case_arrays, jobs, sp_in_diag, sp, hop):
+    """Greedy decision per job (`offloading_v3.py:388-439`), explore=0.
+
+    case_arrays: dict with adj, servers (ascending), ...
+    jobs: list of dicts {src, rate, ul, dl}
+    sp_in_diag: (N,) unit delays that sat on the SP diagonal
+    sp/hop: zero-diagonal matrices.
+    Returns decisions (dst list), delay estimates, routes, hop counts.
+    """
+    servers = case_arrays["servers"]
+    adj = case_arrays["adj"]
+    out = []
+    for job in jobs:
+        src, ul, dl = job["src"], job["ul"], job["dl"]
+        local = sp_in_diag[src] * ul
+        cand = []
+        for s in servers:
+            d_ul = max(sp[src, s] * ul, hop[src, s])
+            d_dl = max(sp[s, src] * dl, hop[s, src])
+            d_pr = max(sp_in_diag[s] * ul, 1.0)
+            cand.append(d_ul + d_dl + d_pr)
+        costs = np.array(cand + [local])
+        k = int(np.argmin(costs))
+        if k < len(servers):
+            dst = int(servers[k])
+            route, hops = greedy_route(adj, sp, src, dst)
+        else:
+            dst, route, hops = src, [src, src], 0
+        out.append(
+            {"dst": dst, "route": route, "nhop": hops, "est": costs[k],
+             "costs": costs}
+        )
+    return out
+
+
+def fixed_point_oracle(link_rates, cf_degs, adj_conflict, link_lambda, iters=10):
+    """`offloading_v3.py:500-506`."""
+    mu = link_rates / (cf_degs + 1.0)
+    for _ in range(iters):
+        with np.errstate(divide="ignore", invalid="ignore"):
+            busy = np.clip(link_lambda / mu, 0.0, 1.0)
+        mu = link_rates / (1.0 + adj_conflict @ busy)
+    return mu
+
+
+def run_oracle(case_arrays, jobs, flows, T):
+    """Empirical delays (`offloading_v3.py:455-550`).
+
+    Returns per-job totals, the unit-delay matrix (NaN = unwritten), and the
+    aggregates, with the reference's exact branch conditions.
+    """
+    link_index = case_arrays["link_index"]
+    link_rates = case_arrays["link_rates"]
+    cf_degs = case_arrays["cf_degs"]
+    adjc = case_arrays["adj_conflict"]
+    proc_bws = case_arrays["proc_bws"]
+    n = proc_bws.shape[0]
+    num_links = link_rates.shape[0]
+    J = len(jobs)
+
+    link_lambda = np.zeros(num_links)
+    server_load = np.zeros(n)
+    for job, fl in zip(jobs, flows):
+        rate_ul = job["ul"] * job["rate"]
+        rate_dl = job["dl"] * job["rate"]
+        if job["src"] != fl["dst"]:
+            for a, b in zip(fl["route"][:-1], fl["route"][1:]):
+                link_lambda[link_index[a, b]] += rate_ul + rate_dl
+        server_load[fl["dst"]] += rate_ul
+
+    mu = fixed_point_oracle(link_rates, cf_degs, adjc, link_lambda)
+
+    unit_mtx = np.full((n, n), np.nan)
+    link_part = np.zeros(J)
+    serv_part = np.zeros(J)
+    for j, (job, fl) in enumerate(zip(jobs, flows)):
+        nhop = float(fl["nhop"])
+        if job["src"] != fl["dst"]:
+            for a, b in zip(fl["route"][:-1], fl["route"][1:]):
+                li = link_index[a, b]
+                if mu[li] - link_lambda[li] <= 0:
+                    u = T * link_lambda[li] / ((job["ul"] + job["dl"]) * mu[li])
+                else:
+                    u = 1.0 / (mu[li] - link_lambda[li])
+                unit_mtx[a, b] = unit_mtx[b, a] = u
+                link_part[j] += max(job["ul"] * u, nhop) + max(job["dl"] * u, nhop)
+        dst = fl["dst"]
+        if proc_bws[dst] - server_load[dst] <= 0:
+            us = T * server_load[dst] / (job["ul"] * proc_bws[dst])
+        else:
+            us = 1.0 / (proc_bws[dst] - server_load[dst])
+        unit_mtx[dst, dst] = us
+        serv_part[j] = max(job["ul"] * us, 1.0)
+
+    return {
+        "total": link_part + serv_part,
+        "link_part": link_part,
+        "server_part": serv_part,
+        "unit_mtx": unit_mtx,
+        "link_lambda": link_lambda,
+        "link_mu": mu,
+        "server_load": server_load,
+    }
+
+
+def case_arrays(rec, link_rates_realized):
+    """Bundle a CaseRecord + realized link rates for the oracle calls."""
+    return {
+        "adj": rec.topo.adj.astype(np.int64),
+        "link_index": rec.topo.link_index,
+        "link_rates": np.asarray(link_rates_realized, dtype=np.float64),
+        "cf_degs": rec.topo.cf_degs.astype(np.float64),
+        "adj_conflict": rec.topo.adj_conflict.astype(np.float64),
+        "proc_bws": rec.proc_bws.astype(np.float64),
+        "servers": np.flatnonzero(rec.roles == 1),
+    }
+
+
+def baseline_oracle(ca, T):
+    """dmtx_baseline semantics (`offloading_v3.py:341-361`)."""
+    with np.errstate(divide="ignore"):
+        dlist = 1.0 / ca["link_rates"]
+        dproc = 1.0 / ca["proc_bws"]
+    n = ca["proc_bws"].shape[0]
+    w = np.full((n, n), np.inf)
+    iu, ju = np.nonzero(ca["adj"])
+    w[iu, ju] = dlist[ca["link_index"][iu, ju]]
+    return w, dlist, dproc

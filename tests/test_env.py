@@ -1,0 +1,225 @@
+"""Env kernels vs the NumPy oracle on real reference cases."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from multihop_offload_tpu.env import (
+    apsp_minplus,
+    baseline_policy,
+    baseline_unit_delays,
+    evaluate_spmatrix_policy,
+    hop_matrix,
+    interference_fixed_point,
+    local_policy,
+    next_hop_table,
+    offload_decide,
+    run_empirical,
+    trace_routes,
+    weight_matrix_from_link_delays,
+)
+from multihop_offload_tpu.graphs.instance import PadSpec, build_instance, build_jobset
+from multihop_offload_tpu.graphs.topology import sample_link_rates
+
+from oracle import refenv
+
+
+def _prep(rec, rng, t_max=1000.0):
+    rates = sample_link_rates(rec.topo, rec.link_rates, rng=rng)
+    pad = PadSpec.for_cases([rec.sizes], round_to=8)
+    inst = build_instance(
+        rec.topo, rec.roles, rec.proc_bws, rates, t_max, pad, dtype=np.float64
+    )
+    ca = refenv.case_arrays(rec, rates)
+    return inst, ca, pad
+
+
+def _sample_jobs(rec, rng, pad, scale=0.15):
+    mobile = rng.permutation(rec.mobile_nodes)
+    nj = rng.integers(max(int(0.3 * mobile.size), 1), mobile.size)
+    srcs = mobile[:nj]
+    rates = scale * rng.uniform(0.1, 0.5, nj)
+    jobs_list = [
+        {"src": int(s), "rate": float(r), "ul": 100.0, "dl": 1.0}
+        for s, r in zip(srcs, rates)
+    ]
+    js = build_jobset(srcs, rates, pad_jobs=pad.j, dtype=np.float64)
+    return jobs_list, js
+
+
+def test_apsp_matches_dijkstra(small_cases, rng):
+    rec = small_cases[0]
+    inst, ca, _ = _prep(rec, rng)
+    w_or, dlist, dproc = refenv.baseline_oracle(ca, 1000.0)
+    n = rec.topo.n
+    link_d, _ = baseline_unit_delays(inst)
+    w = weight_matrix_from_link_delays(inst.adj, inst.link_index, link_d)
+    sp = np.asarray(apsp_minplus(jnp.asarray(w)))
+    sp_or = refenv.apsp_oracle(w_or)
+    np.testing.assert_allclose(sp[:n, :n], sp_or, rtol=1e-12)
+    # padded nodes unreachable
+    assert np.isinf(sp[n:, :n]).all() if sp.shape[0] > n else True
+
+    hop = np.asarray(hop_matrix(inst.adj))
+    np.testing.assert_allclose(hop[:n, :n], refenv.hop_oracle(ca["adj"]), rtol=0)
+
+
+def test_next_hop_and_routes_match_oracle(small_cases, rng):
+    rec = small_cases[0]
+    inst, ca, pad = _prep(rec, rng)
+    link_d, node_d = baseline_unit_delays(inst)
+    w = weight_matrix_from_link_delays(inst.adj, inst.link_index, link_d)
+    sp = apsp_minplus(w)
+    nh = np.asarray(next_hop_table(inst.adj, sp))
+    sp_np = np.asarray(sp)
+
+    jobs_list, js = _sample_jobs(rec, rng, pad)
+    servers = ca["servers"]
+    # route every job to its nearest server via the oracle walker
+    dsts = []
+    for job in jobs_list:
+        s = servers[np.argmin(sp_np[job["src"], servers])]
+        dsts.append(int(s))
+    dst_arr = np.zeros(pad.j, dtype=np.int32)
+    dst_arr[: len(dsts)] = dsts
+    dst_arr[len(dsts):] = js.src[len(dsts):]
+    routes = trace_routes(inst, jnp.asarray(nh), js, jnp.asarray(dst_arr))
+
+    for j, (job, dst) in enumerate(zip(jobs_list, dsts)):
+        route, hops = refenv.greedy_route(ca["adj"], sp_np, job["src"], dst)
+        assert int(routes.nhop[j]) == hops
+        inc = np.asarray(routes.inc_ext[:, j])
+        expect = np.zeros(pad.e)
+        for a, b in zip(route[:-1], route[1:]):
+            expect[ca["link_index"][a, b]] += 1
+        expect[pad.l + dst] += 1
+        np.testing.assert_array_equal(inc, expect)
+    # padded job columns empty
+    assert np.asarray(routes.inc_ext[:, len(dsts):]).sum() == 0
+
+
+def test_fixed_point_matches_oracle(small_cases, rng):
+    rec = small_cases[0]
+    inst, ca, pad = _prep(rec, rng)
+    lam = np.zeros(pad.l)
+    lam[: rec.topo.num_links] = rng.uniform(0, 30, rec.topo.num_links)
+    mu = np.asarray(interference_fixed_point(inst, jnp.asarray(lam)))
+    mu_or = refenv.fixed_point_oracle(
+        ca["link_rates"], ca["cf_degs"], ca["adj_conflict"], lam[: rec.topo.num_links]
+    )
+    np.testing.assert_allclose(mu[: rec.topo.num_links], mu_or, rtol=1e-12)
+
+
+@pytest.mark.parametrize("case_idx,scale", [(0, 0.15), (1, 0.5), (2, 0.15)])
+def test_baseline_policy_end_to_end(small_cases, case_idx, scale):
+    """Full baseline method vs a pure-oracle pipeline, incl. congestion."""
+    rng = np.random.default_rng(100 + case_idx)
+    rec = small_cases[case_idx % len(small_cases)]
+    inst, ca, pad = _prep(rec, rng)
+    jobs_list, js = _sample_jobs(rec, rng, pad, scale=scale)
+
+    out = baseline_policy(inst, js, jax.random.PRNGKey(0), explore=0.0)
+
+    # oracle pipeline
+    w_or, dlist, dproc = refenv.baseline_oracle(ca, 1000.0)
+    sp_or = refenv.apsp_oracle(w_or)
+    hop_or = refenv.hop_oracle(ca["adj"])
+    dec = refenv.offload_oracle(ca, jobs_list, dproc, sp_or, hop_or)
+    res = refenv.run_oracle(ca, jobs_list, dec, 1000.0)
+
+    nj = len(jobs_list)
+    got = np.asarray(out.delays.job_total[:nj])
+    np.testing.assert_allclose(got, res["total"], rtol=1e-9)
+    np.testing.assert_allclose(
+        np.asarray(out.decision.dst[:nj]), [d["dst"] for d in dec]
+    )
+    np.testing.assert_allclose(
+        np.asarray(out.decision.delay_est[:nj]), [d["est"] for d in dec], rtol=1e-9
+    )
+    # aggregates
+    L = rec.topo.num_links
+    np.testing.assert_allclose(
+        np.asarray(out.delays.link_lambda[:L]), res["link_lambda"], rtol=1e-12
+    )
+    np.testing.assert_allclose(
+        np.asarray(out.delays.link_mu[:L]), res["link_mu"], rtol=1e-12
+    )
+    # unit matrix + mask vs NaN-matrix oracle
+    n = rec.topo.n
+    um = np.asarray(out.delays.unit_matrix)[:n, :n]
+    mk = np.asarray(out.delays.unit_mask)[:n, :n]
+    assert (mk == ~np.isnan(res["unit_mtx"])).all()
+    np.testing.assert_allclose(um[mk], res["unit_mtx"][mk], rtol=1e-9)
+
+
+def test_local_policy_matches_oracle(small_cases):
+    rng = np.random.default_rng(7)
+    rec = small_cases[0]
+    inst, ca, pad = _prep(rec, rng)
+    jobs_list, js = _sample_jobs(rec, rng, pad)
+    out = local_policy(inst, js)
+
+    with np.errstate(divide="ignore"):
+        dproc = 1.0 / ca["proc_bws"]
+    flows = [
+        {"dst": job["src"], "route": [job["src"], job["src"]], "nhop": 0}
+        for job in jobs_list
+    ]
+    res = refenv.run_oracle(ca, jobs_list, flows, 1000.0)
+    nj = len(jobs_list)
+    np.testing.assert_allclose(
+        np.asarray(out.delays.job_total[:nj]), res["total"], rtol=1e-9
+    )
+    est = np.asarray(out.decision.delay_est[:nj])
+    np.testing.assert_allclose(
+        est, [max(dproc[j["src"]] * j["ul"], 1.0) for j in jobs_list], rtol=1e-12
+    )
+
+
+def test_explore_and_prob_paths_run(small_cases):
+    rng = np.random.default_rng(3)
+    rec = small_cases[0]
+    inst, ca, pad = _prep(rec, rng)
+    _, js = _sample_jobs(rec, rng, pad)
+    link_d, node_d = baseline_unit_delays(inst)
+    out_e = evaluate_spmatrix_policy(
+        inst, js, link_d, node_d, jax.random.PRNGKey(1), explore=1.0
+    )
+    # exploration must still pick valid compute nodes (servers or the source)
+    dst = np.asarray(out_e.decision.dst)[np.asarray(js.mask)]
+    ok = np.isin(dst, ca["servers"]) | (dst == np.asarray(js.src)[np.asarray(js.mask)])
+    assert ok.all()
+    out_p = evaluate_spmatrix_policy(
+        inst, js, link_d, node_d, jax.random.PRNGKey(2), prob=True
+    )
+    assert np.isfinite(np.asarray(out_p.delays.job_total)[np.asarray(js.mask)]).all()
+
+
+def test_vmap_batch_consistency(small_cases):
+    """vmap over stacked instances == per-instance evaluation."""
+    rng = np.random.default_rng(11)
+    recs = [small_cases[0], small_cases[1]]
+    pad = PadSpec.for_cases([r.sizes for r in recs], round_to=8)
+    insts, jss = [], []
+    for rec in recs:
+        rates = sample_link_rates(rec.topo, rec.link_rates, rng=rng)
+        insts.append(
+            build_instance(rec.topo, rec.roles, rec.proc_bws, rates, 1000.0, pad,
+                           dtype=np.float64)
+        )
+        _, js = _sample_jobs(rec, rng, pad)
+        jss.append(js)
+    from multihop_offload_tpu.graphs.instance import stack_instances
+
+    binst = stack_instances(insts)
+    bjobs = stack_instances(jss)
+    keys = jax.random.split(jax.random.PRNGKey(0), 2)
+    bout = jax.vmap(lambda i, j, k: baseline_policy(i, j, k))(binst, bjobs, keys)
+    for b in range(2):
+        single = baseline_policy(insts[b], jss[b], keys[b])
+        np.testing.assert_allclose(
+            np.asarray(bout.delays.job_total[b]),
+            np.asarray(single.delays.job_total),
+            rtol=1e-12,
+        )
