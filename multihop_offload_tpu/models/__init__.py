@@ -1,0 +1,9 @@
+from multihop_offload_tpu.models.chebconv import (  # noqa: F401
+    ChebConv,
+    ChebNet,
+    chebyshev_support,
+    make_model,
+)
+from multihop_offload_tpu.models.tf_import import (  # noqa: F401
+    load_reference_checkpoint,
+)

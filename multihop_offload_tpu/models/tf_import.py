@@ -1,0 +1,88 @@
+"""Importer for the reference's shipped TensorFlow checkpoints.
+
+The reference saves Keras `save_weights` checkpoints
+(`gnn_offloading_agent.py:131-132`) whose variables are addressed as
+`layer_with_weights-{i}/{kernel,bias}/.ATTRIBUTES/VARIABLE_VALUE` with kernel
+shape (K, in, out) — identical to our ChebConv parameter layout, so the import
+is a rename + cast.  Verified against
+`/root/reference/model/model_ChebConv_BAT800_a5_c5_ACO_agent` (5 layers,
+kernels [1,4,32], [1,32,32]x3, [1,32,1]; 3,361 params).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+import numpy as np
+
+_VAR = "layer_with_weights-{i}/{name}/.ATTRIBUTES/VARIABLE_VALUE"
+
+
+def _checkpoint_prefix(path: str) -> str:
+    """Accept a directory (use its latest checkpoint) or a ckpt prefix."""
+    if os.path.isdir(path):
+        # parse the `checkpoint` metadata file rather than importing TF's
+        # latest_checkpoint helper machinery
+        meta = os.path.join(path, "checkpoint")
+        if os.path.isfile(meta):
+            with open(meta) as f:
+                for line in f:
+                    if line.startswith("model_checkpoint_path"):
+                        name = line.split(":", 1)[1].strip().strip('"')
+                        return os.path.join(path, name)
+        cands = sorted(
+            f[: -len(".index")] for f in os.listdir(path) if f.endswith(".index")
+        )
+        if not cands:
+            raise FileNotFoundError(f"no checkpoint under {path}")
+        return os.path.join(path, cands[-1])
+    return path
+
+
+def load_reference_checkpoint(path: str, dtype=np.float32) -> Dict[str, Any]:
+    """Load reference weights into a Flax `{"params": ...}` tree for ChebNet."""
+    import tensorflow as tf  # local import: only needed for interop
+
+    prefix = _checkpoint_prefix(path)
+    reader = tf.train.load_checkpoint(prefix)
+    params: Dict[str, Any] = {}
+    i = 0
+    while True:
+        kkey = _VAR.format(i=i, name="kernel")
+        try:
+            kernel = reader.get_tensor(kkey)
+        except Exception:
+            break
+        bias = reader.get_tensor(_VAR.format(i=i, name="bias"))
+        params[f"cheb_{i}"] = {
+            "kernel": np.asarray(kernel, dtype=dtype),
+            "bias": np.asarray(bias, dtype=dtype),
+        }
+        i += 1
+    if not params:
+        raise ValueError(f"no ChebConv weights found in {prefix}")
+    return {"params": params}
+
+
+def save_reference_checkpoint(path: str, variables: Dict[str, Any]) -> str:
+    """Write our params out under the reference's exact variable paths
+    (`layer_with_weights-{i}/{kernel,bias}/.ATTRIBUTES/VARIABLE_VALUE`), so
+    the original TF/Spektral code could `load_weights` a model trained here.
+
+    Keras derives that naming from the object graph: the root tracks each
+    weighted layer under the attribute name `layer_with_weights-{i}`; we
+    rebuild the same graph from bare `tf.train.Checkpoint` nodes.
+    """
+    import tensorflow as tf
+
+    params = variables["params"]
+    root = tf.train.Checkpoint()
+    for i in range(len(params)):
+        layer = params[f"cheb_{i}"]
+        node = tf.train.Checkpoint(
+            kernel=tf.Variable(np.asarray(layer["kernel"], dtype=np.float64)),
+            bias=tf.Variable(np.asarray(layer["bias"], dtype=np.float64)),
+        )
+        setattr(root, f"layer_with_weights-{i}", node)
+    return root.write(path)
