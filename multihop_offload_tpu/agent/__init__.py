@@ -1,0 +1,17 @@
+from multihop_offload_tpu.agent.actor import (  # noqa: F401
+    actor_delay_matrix,
+    build_ext_features,
+    ActorOutput,
+)
+from multihop_offload_tpu.agent.policy import forward_env  # noqa: F401
+from multihop_offload_tpu.agent.train_step import (  # noqa: F401
+    forward_backward,
+    TrainStepOutput,
+)
+from multihop_offload_tpu.agent.replay import (  # noqa: F401
+    GradReplay,
+    make_optimizer,
+    replay_init,
+    replay_remember,
+    replay_apply,
+)

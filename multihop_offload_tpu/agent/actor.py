@@ -1,0 +1,113 @@
+"""Actor forward pass: GNN arrival-rate prediction -> unit-delay matrix.
+
+Reimplements `ACOAgent.forward` (`gnn_offloading_agent.py:211-276`) as one
+differentiable fixed-shape function: build extended-line-graph features, apply
+the ChebNet to predict per-slot arrival rates lambda, run the differentiable
+interference fixed point, convert to unit delays with the congestion
+substitution, and scatter into the (N, N) delay matrix whose off-diagonals are
+link delays and whose diagonal is per-node compute delay (+inf on relays,
+which can never attract compute).
+
+Deviation from the reference, documented in PARITY.md: the reference's NumPy
+copy of the diagonal is mis-aligned when relays exist (`np.fill_diagonal` with
+a shorter compute-node vector cycles, `gnn_offloading_agent.py:269`); its TF
+tensor does it correctly (`:270-274`).  We implement the correct scatter for
+both value and gradient paths.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from multihop_offload_tpu.env.queueing import interference_fixed_point
+from multihop_offload_tpu.graphs.instance import Instance, JobSet
+
+
+@struct.dataclass
+class ActorOutput:
+    delay_matrix: jnp.ndarray  # (N, N)
+    link_delay: jnp.ndarray    # (L,) per-link unit delays
+    node_delay: jnp.ndarray    # (N,) per-node unit delays (garbage-free,
+    #                            masked to comp nodes; inf never stored here)
+    lam: jnp.ndarray           # (E,) raw GNN output
+
+
+def build_ext_features(inst: Instance, jobs: JobSet) -> jnp.ndarray:
+    """(E, 4) features: [self_loop, rate, exogenous arrivals, is_server]
+    (`gnn_offloading_agent.py:219-224`; arrivals from `graph_expand`'s
+    jobs_info, `offloading_v3.py:278-282`)."""
+    n = inst.num_pad_nodes
+    arr = jnp.zeros((n,), dtype=inst.ext_rate.dtype).at[jobs.src].add(
+        jnp.where(jobs.mask, jobs.rate * jobs.ul, 0.0)
+    )
+    num_links = inst.num_pad_links
+    jobs_arrivals = jnp.concatenate(
+        [jnp.zeros((num_links,), arr.dtype), arr * inst.comp_mask]
+    )
+    return jnp.stack(
+        [inst.ext_self_loop, inst.ext_rate, jobs_arrivals, inst.ext_as_server],
+        axis=1,
+    )
+
+
+def lambdas_to_delay_matrix(inst: Instance, lam: jnp.ndarray) -> ActorOutput:
+    """Differentiable head: lambda (E,) -> delay matrix
+    (`gnn_offloading_agent.py:229-276`)."""
+    num_links = inst.num_pad_links
+    n = inst.num_pad_nodes
+    lam = lam * inst.ext_mask  # padded slots predict nothing
+    link_lambda = lam[:num_links]
+    node_lambda = jnp.where(inst.comp_mask, lam[num_links:], 0.0)
+
+    link_mu = interference_fixed_point(inst, link_lambda)
+    # link unit delay 1/(mu-lambda); congested (lambda-mu > 0, strict — the
+    # empirical evaluator uses >=, a reference asymmetry we keep) replaced by
+    # T*lambda/(101*mu)  (`:245-253`)
+    l_slack = link_mu - link_lambda
+    l_cong = (link_lambda - link_mu) > 0
+    link_delay = jnp.where(
+        l_cong,
+        inst.T * link_lambda / (101.0 * link_mu),
+        1.0 / jnp.where(l_cong, 1.0, l_slack),
+    )
+    # node unit delay over compute-capable nodes only (the reference gathers
+    # comp_nodes and never materializes relay entries, `:233-235`)
+    node_mu = jnp.where(inst.comp_mask, inst.proc_bws, 1.0)
+    n_slack = node_mu - node_lambda
+    n_cong = ((node_lambda - node_mu) > 0) & inst.comp_mask
+    node_delay = jnp.where(
+        n_cong,
+        inst.T * node_lambda / (100.0 * node_mu),
+        1.0 / jnp.where(n_cong, 1.0, n_slack),
+    )
+    node_delay = jnp.where(inst.comp_mask, node_delay, 0.0)
+
+    u, v = inst.link_ends[:, 0], inst.link_ends[:, 1]
+    masked_link_delay = jnp.where(inst.link_mask, link_delay, 0.0)
+    dmtx = jnp.zeros((n, n), lam.dtype)
+    dmtx = dmtx.at[u, v].set(masked_link_delay)
+    dmtx = dmtx.at[v, u].set(masked_link_delay)
+    diag = jnp.where(inst.comp_mask, node_delay, jnp.inf)  # (`:270-274`)
+    dmtx = dmtx.at[jnp.arange(n), jnp.arange(n)].set(diag)
+    return ActorOutput(
+        delay_matrix=dmtx, link_delay=link_delay, node_delay=node_delay, lam=lam
+    )
+
+
+def actor_delay_matrix(
+    model,
+    variables,
+    inst: Instance,
+    jobs: JobSet,
+    support: jnp.ndarray,
+    deterministic: bool = True,
+    dropout_rng: jax.Array | None = None,
+) -> ActorOutput:
+    feats = build_ext_features(inst, jobs)
+    rngs = {"dropout": dropout_rng} if dropout_rng is not None else None
+    lam = model.apply(
+        variables, feats, support, deterministic=deterministic, rngs=rngs
+    )[:, 0]
+    return lambdas_to_delay_matrix(inst, lam)

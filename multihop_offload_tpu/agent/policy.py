@@ -1,0 +1,36 @@
+"""GNN policy evaluation — the deployable inference path.
+
+Reimplements `forward_env` (`gnn_offloading_agent.py:278-291`): actor forward
+-> shortest paths over predicted delays -> greedy offloading -> empirical
+evaluation.  One pure function, jit/vmap-ready; the reference crosses the
+TF<->NumPy<->NetworkX boundary twice here, we never leave the device.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from multihop_offload_tpu.agent.actor import ActorOutput, actor_delay_matrix
+from multihop_offload_tpu.env.policies import PolicyOutcome, evaluate_spmatrix_policy
+from multihop_offload_tpu.graphs.instance import Instance, JobSet
+
+
+def forward_env(
+    model,
+    variables,
+    inst: Instance,
+    jobs: JobSet,
+    key: jax.Array,
+    support: jnp.ndarray | None = None,
+    explore=0.0,
+    prob: bool = False,
+) -> tuple[PolicyOutcome, ActorOutput]:
+    if support is None:
+        support = inst.adj_ext  # reference compat: raw ext adjacency
+    actor = actor_delay_matrix(model, variables, inst, jobs, support)
+    unit_diag = jnp.diagonal(actor.delay_matrix)
+    outcome = evaluate_spmatrix_policy(
+        inst, jobs, actor.link_delay, unit_diag, key, explore=explore, prob=prob
+    )
+    return outcome, actor

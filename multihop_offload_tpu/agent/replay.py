@@ -1,0 +1,151 @@
+"""Gradient-replay memory and the optimizer of record.
+
+The reference's experience replay stores *gradients*, not transitions
+(`gnn_offloading_agent.py:76,141-142,156-169`): every `forward_backward`
+memorizes its gradient pytree; `replay(batch)` samples `batch` stored
+gradients and applies them sequentially with Adam.  Here the memory is a
+preallocated on-device ring buffer (a pytree with a leading capacity axis) and
+the sequential application is one `lax.scan` — the whole replay step is a
+single XLA program.
+
+Optimizer parity: Keras `Adam(lr, clipnorm=1.0)` clips each variable's
+gradient norm individually (not the global norm) and uses eps=1e-7; Keras
+`max_norm(1.0)` weight constraints are applied after every update
+(axis-0 norms, keras epsilon rescale).  All three are reproduced.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from jax import lax
+
+from multihop_offload_tpu.config import Config
+
+_KERAS_EPS = 1e-7
+
+
+@struct.dataclass
+class GradReplay:
+    grads: Any              # pytree, leaves (M, *leaf_shape)
+    loss_critic: jnp.ndarray  # (M,)
+    loss_mse: jnp.ndarray     # (M,)
+    count: jnp.ndarray        # () int32 — filled slots
+    ptr: jnp.ndarray          # () int32 — next write position
+
+
+def replay_init(params: Any, capacity: int) -> GradReplay:
+    zeros = jax.tree_util.tree_map(
+        lambda p: jnp.zeros((capacity,) + p.shape, p.dtype), params
+    )
+    return GradReplay(
+        grads=zeros,
+        loss_critic=jnp.zeros((capacity,)),
+        loss_mse=jnp.zeros((capacity,)),
+        count=jnp.zeros((), jnp.int32),
+        ptr=jnp.zeros((), jnp.int32),
+    )
+
+
+def replay_remember(mem: GradReplay, grads: Any, loss_critic, loss_mse) -> GradReplay:
+    """Ring-buffer append (deque(maxlen=capacity) semantics)."""
+    capacity = mem.loss_critic.shape[0]
+    i = mem.ptr
+    new_grads = jax.tree_util.tree_map(
+        lambda buf, g: lax.dynamic_update_index_in_dim(buf, g.astype(buf.dtype), i, 0),
+        mem.grads,
+        grads,
+    )
+    return GradReplay(
+        grads=new_grads,
+        loss_critic=mem.loss_critic.at[i].set(jnp.asarray(loss_critic, mem.loss_critic.dtype)),
+        loss_mse=mem.loss_mse.at[i].set(jnp.asarray(loss_mse, mem.loss_mse.dtype)),
+        count=jnp.minimum(mem.count + 1, capacity),
+        ptr=(mem.ptr + 1) % capacity,
+    )
+
+
+def _clip_by_leaf_norm(max_norm: float) -> optax.GradientTransformation:
+    """Keras `clipnorm`: per-variable (per-leaf) norm clipping."""
+
+    def update(updates, state, params=None):
+        del params
+
+        def clip(g):
+            norm = jnp.sqrt(jnp.sum(g * g))
+            scale = jnp.where(norm > max_norm, max_norm / (norm + 1e-16), 1.0)
+            return g * scale
+
+        return jax.tree_util.tree_map(clip, updates), state
+
+    return optax.GradientTransformation(lambda _: optax.EmptyState(), update)
+
+
+def make_optimizer(cfg: Config) -> optax.GradientTransformation:
+    """Adam(lr, clipnorm=1) with optional exponential decay
+    (`gnn_offloading_agent.py:113-121`)."""
+    if cfg.learning_decay == 1.0:
+        lr = cfg.learning_rate
+    else:
+        lr = optax.exponential_decay(
+            init_value=cfg.learning_rate,
+            transition_steps=100,
+            decay_rate=cfg.learning_decay,
+        )
+    return optax.chain(
+        _clip_by_leaf_norm(cfg.clipnorm),
+        optax.adam(lr, b1=0.9, b2=0.999, eps=_KERAS_EPS),
+    )
+
+
+def apply_max_norm_constraint(params: Any, max_value: float) -> Any:
+    """Keras `max_norm(axis=0)` applied to every kernel/bias after each
+    update (`gnn_offloading_agent.py:107-108` + Keras constraint semantics:
+    w *= clip(norm, 0, max) / (eps + norm), norms over axis 0)."""
+
+    def constrain(w):
+        norms = jnp.sqrt(jnp.sum(w * w, axis=0, keepdims=True))
+        desired = jnp.clip(norms, 0.0, max_value)
+        return w * (desired / (_KERAS_EPS + norms))
+
+    return jax.tree_util.tree_map(constrain, params)
+
+
+def replay_apply(
+    mem: GradReplay,
+    params: Any,
+    opt_state: Any,
+    optimizer: optax.GradientTransformation,
+    key: jax.Array,
+    batch: int,
+    max_norm: float = 1.0,
+):
+    """Sample `batch` stored gradients uniformly without replacement and apply
+    them sequentially (`gnn_offloading_agent.py:156-169`).
+
+    Caller must ensure count >= batch (the reference returns NaN and skips
+    otherwise — that check lives in the driver, where count is host-visible).
+    Returns (params, opt_state, mean sampled critic loss — what the
+    reference's `replay` reports, `:162-169`).
+    """
+    capacity = mem.loss_critic.shape[0]
+    # uniform sample w/o replacement over the filled prefix via Gumbel top-k
+    scores = jax.random.uniform(key, (capacity,))
+    scores = jnp.where(jnp.arange(capacity) < mem.count, scores, -jnp.inf)
+    _, idx = lax.top_k(scores, batch)
+
+    def step(carry, i):
+        p, s = carry
+        g = jax.tree_util.tree_map(lambda buf: buf[i], mem.grads)
+        updates, s = optimizer.update(g, s, p)
+        p = optax.apply_updates(p, updates)
+        p = apply_max_norm_constraint(p, max_norm)
+        return (p, s), None
+
+    (params, opt_state), _ = lax.scan(step, (params, opt_state), idx)
+    mean_loss = jnp.mean(mem.loss_critic[idx])
+    return params, opt_state, mean_loss

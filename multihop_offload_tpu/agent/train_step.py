@@ -1,0 +1,218 @@
+"""The training-math core: actor -> env -> analytic critic -> parameter grads.
+
+Reimplements `ACOAgent.forward_backward` (`gnn_offloading_agent.py:293-453`)
+— the reference's novel actor / analytic-critic scheme — as ONE pure jitted
+function.  The reference crosses the TF<->NumPy boundary four times per call
+(SURVEY.md §3.3); here the whole chain is a single XLA program:
+
+1. actor VJP: delay matrix D(theta) captured with `jax.vjp`;
+2. env decision path (non-differentiable: APSP, argmin offloading, routing,
+   empirical `run`) on stopped values;
+3. critic: with routes R fixed, the analytic congestion model's total delay
+   L(R) is differentiated w.r.t. R (through the 10-step fixed point, as the
+   reference's inner GradientTape does, `:333-374`);
+4. suffix-bias reconstruction (`:384-409`): the reference builds per-route
+   suffix sums of unit delays ("SP bias") and backpropagates -dL/dR through
+   them onto per-edge unit delays.  Mathematically that gradient is, for each
+   job, the along-route prefix sum of -dL/dR scattered onto the route's
+   edges — computed here with one scan over the recorded route step
+   sequence, no O(L) index lookups;
+5. scatter onto the (N, N) distance-gradient (`:410-416`), add the MSE
+   supervision term 0.001*(D - D_emp) on written entries (`:440-444`), and
+   pull the composed cotangent back through the actor VJP (`:448`).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+from jax import lax
+
+from multihop_offload_tpu.agent.actor import (
+    ActorOutput,
+    actor_delay_matrix,
+    lambdas_to_delay_matrix,
+)
+from multihop_offload_tpu.env.apsp import (
+    apsp_minplus,
+    hop_matrix,
+    next_hop_table,
+    weight_matrix_from_link_delays,
+)
+from multihop_offload_tpu.env.offloading import offload_decide
+from multihop_offload_tpu.env.queueing import (
+    EmpiricalDelays,
+    interference_fixed_point,
+    run_empirical,
+)
+from multihop_offload_tpu.env.routing import RouteSet, trace_routes
+from multihop_offload_tpu.graphs.instance import Instance, JobSet
+
+
+@struct.dataclass
+class TrainStepOutput:
+    grads: Any                  # pytree like params: d(total delay)/d theta
+    loss_critic: jnp.ndarray    # () analytic critic total delay (`loss_fn`)
+    loss_mse: jnp.ndarray       # () masked mean((D - D_emp)^2)
+    delays: EmpiricalDelays
+    routes: RouteSet
+    actor: ActorOutput
+    dst: jnp.ndarray            # (J,)
+
+
+def _critic_loss(
+    inst: Instance, jobs: JobSet, routes_inc: jnp.ndarray
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Analytic congestion-model delay of fixed routes
+    (`gnn_offloading_agent.py:333-374`).  Returns (loss, unit_edge)."""
+    num_links = inst.num_pad_links
+    load = routes_inc @ jnp.where(jobs.mask, jobs.rate * jobs.ul, 0.0)  # (E,)
+    link_lambda = load[:num_links]
+    node_lambda = jnp.where(inst.comp_mask, load[num_links:], 0.0)
+
+    link_mu = interference_fixed_point(inst, link_lambda)
+    l_cong = (link_lambda - link_mu) > 0
+    link_delay = jnp.where(
+        l_cong,
+        inst.T * link_lambda / (101.0 * link_mu),
+        1.0 / jnp.where(l_cong, 1.0, link_mu - link_lambda),
+    )
+    node_mu = jnp.where(inst.comp_mask, inst.proc_bws, 1.0)
+    n_cong = ((node_lambda - node_mu) > 0) & inst.comp_mask
+    node_delay = jnp.where(
+        n_cong,
+        inst.T * node_lambda / (100.0 * node_mu),
+        1.0 / jnp.where(n_cong, 1.0, node_mu - node_lambda),
+    )
+    node_delay = jnp.where(inst.comp_mask, node_delay, 0.0)
+
+    unit_edge = jnp.concatenate([link_delay, node_delay])        # (E,)
+    # delay per (slot, job): max(data * unit * r, r); multiply_no_nan
+    # semantics via a mask (`:370-372`)
+    data = jobs.ul + jobs.dl                                     # (J,)
+    prod = jnp.where(routes_inc > 0, unit_edge[:, None] * routes_inc, 0.0)
+    delay_job_edge = jnp.maximum(data[None, :] * prod, routes_inc)
+    return jnp.sum(delay_job_edge), unit_edge
+
+
+def _suffix_bias_grad(
+    inst: Instance,
+    jobs: JobSet,
+    routes: RouteSet,
+    grad_routes: jnp.ndarray,
+) -> jnp.ndarray:
+    """Per-ext-slot gradient from the reference's suffix-bias trick.
+
+    bias[e_k, j] = sum_{i >= k} unit[e_i] along job j's route (pseudo-link
+    last), and grad_edge = d(sum bias * -grad_routes)/d unit  (`:384-409`).
+    Since d bias[e_k]/d unit[e_i] = [i >= k], the contribution of job j to
+    grad_edge[e_i] is the prefix sum of -grad_routes over the route up to i —
+    one scan over the recorded step sequence.
+    """
+    num_jobs = jobs.src.shape[0]
+    num_slots = routes.inc_ext.shape[0]
+    cols = jnp.arange(num_jobs)
+
+    def step(carry, inputs):
+        cum, grad_edge = carry
+        slots, active = inputs
+        a = active.astype(grad_routes.dtype)
+        cum = cum - grad_routes[slots, cols] * a
+        grad_edge = grad_edge.at[slots, cols].add(cum * a)
+        return (cum, grad_edge), None
+
+    init = (
+        jnp.zeros((num_jobs,), grad_routes.dtype),
+        jnp.zeros((num_slots, num_jobs), grad_routes.dtype),
+    )
+    (cum, grad_edge), _ = lax.scan(
+        step, init, (routes.seq_slot, routes.seq_active)
+    )
+    # final pseudo-link step at the destination (`:390-403` first iteration
+    # of the reference's reverse walk == last of the forward order)
+    pseudo = inst.num_pad_links + routes.dst
+    a = jobs.mask.astype(grad_routes.dtype)
+    cum = cum - grad_routes[pseudo, cols] * a
+    grad_edge = grad_edge.at[pseudo, cols].add(cum * a)
+    return grad_edge.sum(axis=1)                                 # (E,)
+
+
+def _grad_edge_to_distance(
+    inst: Instance, grad_edge: jnp.ndarray
+) -> jnp.ndarray:
+    """Scatter per-slot gradients onto the (N, N) distance cotangent
+    (`:410-416`): real links symmetric off-diagonal, pseudo-links diagonal."""
+    n = inst.num_pad_nodes
+    num_links = inst.num_pad_links
+    u, v = inst.link_ends[:, 0], inst.link_ends[:, 1]
+    g_link = jnp.where(inst.link_mask, grad_edge[:num_links], 0.0)
+    g = jnp.zeros((n, n), grad_edge.dtype)
+    g = g.at[u, v].set(g_link)
+    g = g.at[v, u].set(g_link)
+    diag = jnp.where(inst.comp_mask, grad_edge[num_links:], 0.0)
+    g = g.at[jnp.arange(n), jnp.arange(n)].set(diag)
+    return g
+
+
+def forward_backward(
+    model,
+    variables,
+    inst: Instance,
+    jobs: JobSet,
+    key: jax.Array,
+    support: jnp.ndarray | None = None,
+    explore=0.0,
+    prob: bool = False,
+    mse_weight: float = 0.001,
+) -> TrainStepOutput:
+    if support is None:
+        support = inst.adj_ext
+
+    # --- 1. actor forward under VJP -------------------------------------
+    def actor_fn(params_tree):
+        out = actor_delay_matrix(model, params_tree, inst, jobs, support)
+        return out.delay_matrix, out
+
+    dmtx, vjp_fn, actor = jax.vjp(actor_fn, variables, has_aux=True)
+
+    # --- 2. env decision path on stopped values -------------------------
+    link_delay = lax.stop_gradient(actor.link_delay)
+    unit_diag = lax.stop_gradient(jnp.diagonal(dmtx))
+    w = weight_matrix_from_link_delays(inst.adj, inst.link_index, link_delay)
+    sp = apsp_minplus(w)
+    hop = hop_matrix(inst.adj)
+    dec = offload_decide(inst, jobs, sp, hop, unit_diag, key, explore, prob)
+    routes = trace_routes(inst, next_hop_table(inst.adj, sp), jobs, dec.dst)
+    delays = run_empirical(inst, jobs, routes)
+
+    # --- 3. critic gradient w.r.t. routes -------------------------------
+    (loss_critic, unit_edge), grad_routes = jax.value_and_grad(
+        lambda r: _critic_loss(inst, jobs, r), has_aux=True
+    )(routes.inc_ext)
+
+    # --- 4. suffix-bias gradient onto unit delays -----------------------
+    grad_edge = _suffix_bias_grad(inst, jobs, routes, grad_routes)
+    grad_dist = _grad_edge_to_distance(inst, grad_edge)
+
+    # --- 5. MSE supervision on written entries (`:440-444`) -------------
+    emp = delays.unit_matrix
+    mse_mask = delays.unit_mask & jnp.isfinite(emp)
+    diff = jnp.where(mse_mask, dmtx - emp, 0.0)
+    denom = jnp.maximum(mse_mask.sum(), 1)
+    loss_mse = jnp.sum(jnp.where(mse_mask, diff * diff, 0.0)) / denom
+    grad_dist = grad_dist + mse_weight * diff
+
+    # --- pull back through the actor ------------------------------------
+    grads = vjp_fn(grad_dist)[0]
+    return TrainStepOutput(
+        grads=grads,
+        loss_critic=loss_critic,
+        loss_mse=loss_mse,
+        delays=delays,
+        routes=routes,
+        actor=actor,
+        dst=dec.dst,
+    )

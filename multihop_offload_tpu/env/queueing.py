@@ -52,12 +52,16 @@ def interference_fixed_point(
     """
     mu0 = inst.link_rates / (inst.cf_degs + 1.0)
 
-    def body(_, mu):
+    def body(mu, _):
         busy = jnp.clip(link_lambda / mu, 0.0, 1.0)
         neighbor_busy = inst.adj_conflict @ busy
-        return inst.link_rates / (1.0 + neighbor_busy)
+        return inst.link_rates / (1.0 + neighbor_busy), None
 
-    return lax.fori_loop(0, num_iters, body, mu0)
+    # lax.scan (not fori_loop) so both differentiable critics can reverse-
+    # differentiate through the unrolled iterations, as the reference's
+    # GradientTape does (`gnn_offloading_agent.py:240-244`, `:348-352`).
+    mu, _ = lax.scan(body, mu0, None, length=num_iters)
+    return mu
 
 
 def run_empirical(

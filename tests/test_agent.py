@@ -1,0 +1,273 @@
+"""Agent: actor head, forward_env golden test, forward_backward math, replay."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from multihop_offload_tpu.config import Config
+from multihop_offload_tpu.agent import (
+    actor_delay_matrix,
+    build_ext_features,
+    forward_backward,
+    forward_env,
+    make_optimizer,
+    replay_apply,
+    replay_init,
+    replay_remember,
+)
+from multihop_offload_tpu.agent.train_step import _critic_loss, _suffix_bias_grad
+from multihop_offload_tpu.agent.replay import apply_max_norm_constraint
+from multihop_offload_tpu.graphs.instance import PadSpec, build_instance, build_jobset
+from multihop_offload_tpu.graphs.topology import sample_link_rates
+from multihop_offload_tpu.models import ChebNet, load_reference_checkpoint
+
+from oracle import refenv
+from tests.conftest import REFERENCE_CKPT
+
+
+@pytest.fixture(scope="module")
+def setup(small_cases):
+    rng = np.random.default_rng(42)
+    rec = small_cases[0]
+    rates = sample_link_rates(rec.topo, rec.link_rates, rng=rng)
+    pad = PadSpec.for_cases([rec.sizes], round_to=8)
+    inst = build_instance(
+        rec.topo, rec.roles, rec.proc_bws, rates, 1000.0, pad, dtype=np.float64
+    )
+    ca = refenv.case_arrays(rec, rates)
+    mobile = rng.permutation(rec.mobile_nodes)
+    nj = max(3, mobile.size // 2)
+    srcs, jrates = mobile[:nj], 0.15 * rng.uniform(0.1, 0.5, nj)
+    jobs_list = [
+        {"src": int(s), "rate": float(r), "ul": 100.0, "dl": 1.0}
+        for s, r in zip(srcs, jrates)
+    ]
+    js = build_jobset(srcs, jrates, pad_jobs=pad.j, dtype=np.float64)
+    model = ChebNet(param_dtype=jnp.float64)
+    variables = load_reference_checkpoint(REFERENCE_CKPT, dtype=np.float64)
+    return rec, ca, inst, js, jobs_list, model, variables, pad
+
+
+def _oracle_lambda(variables, feats):
+    """Numpy forward of the K=1 stack."""
+    h = feats
+    for i in range(5):
+        w = np.asarray(variables["params"][f"cheb_{i}"]["kernel"])[0]
+        b = np.asarray(variables["params"][f"cheb_{i}"]["bias"])
+        h = h @ w + b
+        h = np.maximum(h, 0) if i == 4 else np.where(h > 0, h, 0.2 * h)
+    return h[:, 0]
+
+
+def _oracle_delay_matrix(ca, lam_link, lam_node, T=1000.0):
+    """Reference `forward` math in numpy (`gnn_offloading_agent.py:229-274`)."""
+    mu = refenv.fixed_point_oracle(
+        ca["link_rates"], ca["cf_degs"], ca["adj_conflict"], lam_link
+    )
+    link_delay = np.where(
+        lam_link - mu > 0, T * lam_link / (101 * mu), 1.0 / (mu - lam_link)
+    )
+    n = ca["proc_bws"].shape[0]
+    comp = ca["proc_bws"] > 0
+    node_delay = np.full(n, np.inf)
+    bw, lamn = ca["proc_bws"][comp], lam_node[comp]
+    node_delay[comp] = np.where(
+        lamn - bw > 0, T * lamn / (100 * bw), 1.0 / (bw - lamn)
+    )
+    D = np.full((n, n), np.nan)
+    iu, ju = np.nonzero(ca["adj"])
+    D[iu, ju] = link_delay[ca["link_index"][iu, ju]]
+    np.fill_diagonal(D, node_delay)
+    return D, link_delay, node_delay
+
+
+def test_features_match_reference_layout(setup):
+    rec, ca, inst, js, jobs_list, model, variables, pad = setup
+    feats = np.asarray(build_ext_features(inst, js))
+    L = pad.l
+    nlinks = rec.topo.num_links
+    assert (feats[:nlinks, 0] == 0).all() and (feats[:nlinks, 3] == 0).all()
+    np.testing.assert_allclose(feats[:nlinks, 1], ca["link_rates"])
+    arrivals = np.zeros(rec.topo.n)
+    for j in jobs_list:
+        arrivals[j["src"]] += j["rate"] * j["ul"]
+    np.testing.assert_allclose(feats[L : L + rec.topo.n, 2], arrivals)
+    comp = ca["proc_bws"] > 0
+    np.testing.assert_allclose(feats[L : L + rec.topo.n, 0], comp.astype(float))
+
+
+def test_actor_delay_matrix_matches_oracle(setup):
+    rec, ca, inst, js, jobs_list, model, variables, pad = setup
+    out = actor_delay_matrix(model, variables, inst, js, inst.adj_ext)
+    feats = np.asarray(build_ext_features(inst, js))
+    lam = _oracle_lambda(variables, feats)
+    lam_link = lam[: rec.topo.num_links]
+    lam_node = lam[pad.l : pad.l + rec.topo.n].copy()
+    lam_node[ca["proc_bws"] <= 0] = 0.0
+    D_or, link_d_or, node_d_or = _oracle_delay_matrix(ca, lam_link, lam_node)
+    n = rec.topo.n
+    D = np.asarray(out.delay_matrix)[:n, :n]
+    mask = ~np.isnan(D_or)
+    np.testing.assert_allclose(D[mask], D_or[mask], rtol=1e-9)
+    # non-edges are exactly zero off-diagonal in our dense matrix
+    offdiag_nonedge = (~mask) & ~np.eye(n, dtype=bool)
+    assert (D[offdiag_nonedge] == 0).all()
+    np.testing.assert_allclose(
+        np.asarray(out.link_delay)[: rec.topo.num_links], link_d_or, rtol=1e-9
+    )
+
+
+def test_forward_env_golden_vs_oracle_pipeline(setup):
+    rec, ca, inst, js, jobs_list, model, variables, pad = setup
+    outcome, actor = jax.jit(
+        lambda v, i, j, k: forward_env(model, v, i, j, k)
+    )(variables, inst, js, jax.random.PRNGKey(0))
+
+    feats = np.asarray(build_ext_features(inst, js))
+    lam = _oracle_lambda(variables, feats)
+    lam_node = lam[pad.l : pad.l + rec.topo.n].copy()
+    lam_node[ca["proc_bws"] <= 0] = 0.0
+    D_or, link_d_or, _ = _oracle_delay_matrix(ca, lam[: rec.topo.num_links], lam_node)
+    n = rec.topo.n
+    w = np.full((n, n), np.inf)
+    iu, ju = np.nonzero(ca["adj"])
+    w[iu, ju] = link_d_or[ca["link_index"][iu, ju]]
+    sp_or = refenv.apsp_oracle(w)
+    hop_or = refenv.hop_oracle(ca["adj"])
+    dec = refenv.offload_oracle(ca, jobs_list, np.diagonal(D_or), sp_or, hop_or)
+    res = refenv.run_oracle(ca, jobs_list, dec, 1000.0)
+
+    nj = len(jobs_list)
+    np.testing.assert_allclose(
+        np.asarray(outcome.decision.dst[:nj]), [d["dst"] for d in dec]
+    )
+    np.testing.assert_allclose(
+        np.asarray(outcome.delays.job_total[:nj]), res["total"], rtol=1e-9
+    )
+
+
+def test_suffix_bias_grad_matches_bruteforce(setup):
+    rec, ca, inst, js, jobs_list, model, variables, pad = setup
+    out = forward_backward(
+        model, variables, inst, js, jax.random.PRNGKey(1)
+    )
+    routes = out.routes
+    rng = np.random.default_rng(5)
+    grad_routes = jnp.asarray(rng.normal(size=routes.inc_ext.shape))
+    got = np.asarray(_suffix_bias_grad(inst, js, routes, grad_routes))
+
+    # brute force from explicit route edge sequences
+    expect = np.zeros(pad.e)
+    seq = np.asarray(routes.seq_slot)
+    act = np.asarray(routes.seq_active)
+    gr = np.asarray(grad_routes)
+    for j in range(pad.j):
+        if not np.asarray(js.mask)[j]:
+            continue
+        edges = [int(seq[h, j]) for h in range(seq.shape[0]) if act[h, j]]
+        edges.append(pad.l + int(np.asarray(routes.dst)[j]))
+        c = 0.0
+        for e in edges:
+            c -= gr[e, j]
+            expect[e] += c
+    np.testing.assert_allclose(got, expect, rtol=1e-10, atol=1e-12)
+
+
+def test_critic_loss_matches_numpy(setup):
+    rec, ca, inst, js, jobs_list, model, variables, pad = setup
+    out = forward_backward(model, variables, inst, js, jax.random.PRNGKey(1))
+    inc = np.asarray(out.routes.inc_ext)
+    jmask = np.asarray(js.mask)
+    load = inc @ np.where(jmask, np.asarray(js.rate) * np.asarray(js.ul), 0.0)
+    lam_link = load[: pad.l][: rec.topo.num_links]
+    mu = refenv.fixed_point_oracle(
+        ca["link_rates"], ca["cf_degs"], ca["adj_conflict"], lam_link
+    )
+    link_delay = np.where(
+        lam_link - mu > 0, 1000.0 * lam_link / (101 * mu), 1.0 / (mu - lam_link)
+    )
+    comp = ca["proc_bws"] > 0
+    lam_node = load[pad.l : pad.l + rec.topo.n] * comp
+    node_delay = np.zeros(rec.topo.n)
+    node_delay[comp] = np.where(
+        lam_node[comp] - ca["proc_bws"][comp] > 0,
+        1000.0 * lam_node[comp] / (100 * ca["proc_bws"][comp]),
+        1.0 / (ca["proc_bws"][comp] - lam_node[comp]),
+    )
+    unit = np.zeros(pad.e)
+    unit[: rec.topo.num_links] = link_delay
+    unit[pad.l : pad.l + rec.topo.n] = node_delay
+    data = np.asarray(js.ul) + np.asarray(js.dl)
+    dje = np.maximum(data[None, :] * np.where(inc > 0, unit[:, None] * inc, 0.0), inc)
+    np.testing.assert_allclose(float(out.loss_critic), dje.sum(), rtol=1e-9)
+
+
+def test_forward_backward_grads_finite_and_vjp_consistent(setup):
+    rec, ca, inst, js, jobs_list, model, variables, pad = setup
+    out = jax.jit(
+        lambda v, i, j, k: forward_backward(model, v, i, j, k)
+    )(variables, inst, js, jax.random.PRNGKey(3))
+    flat, _ = jax.flatten_util.ravel_pytree(out.grads)
+    assert np.isfinite(np.asarray(flat)).all()
+    assert float(jnp.abs(flat).sum()) > 0
+    assert np.isfinite(float(out.loss_critic)) and np.isfinite(float(out.loss_mse))
+
+    # vjp composition == grad of the linear surrogate <grad_dist, D(theta)>
+    from multihop_offload_tpu.agent.train_step import (
+        _grad_edge_to_distance,
+        _suffix_bias_grad,
+    )
+
+    grad_routes = jax.grad(lambda r: _critic_loss(inst, js, r)[0])(out.routes.inc_ext)
+    grad_edge = _suffix_bias_grad(inst, js, out.routes, grad_routes)
+    gd = _grad_edge_to_distance(inst, grad_edge)
+    emp = out.delays.unit_matrix
+    mask = out.delays.unit_mask & jnp.isfinite(emp)
+    gd = gd + 0.001 * jnp.where(mask, out.actor.delay_matrix - emp, 0.0)
+    gd = jax.lax.stop_gradient(gd)
+
+    def surrogate(v):
+        a = actor_delay_matrix(model, v, inst, js, inst.adj_ext)
+        contrib = jnp.where(jnp.isfinite(a.delay_matrix), gd * a.delay_matrix, 0.0)
+        return jnp.sum(contrib)
+
+    g2 = jax.grad(surrogate)(variables)
+    flat2, _ = jax.flatten_util.ravel_pytree(g2)
+    np.testing.assert_allclose(np.asarray(flat), np.asarray(flat2), rtol=1e-8, atol=1e-12)
+
+
+def test_replay_buffer_and_optimizer(setup):
+    rec, ca, inst, js, jobs_list, model, variables, pad = setup
+    cfg = Config(learning_rate=1e-3, dtype="float64")
+    params = variables["params"]
+    opt = make_optimizer(cfg)
+    opt_state = opt.init(params)
+    mem = replay_init(params, capacity=8)
+
+    out = forward_backward(model, variables, inst, js, jax.random.PRNGKey(7))
+    for i in range(10):  # overfill to exercise the ring
+        mem = replay_remember(mem, out.grads["params"], out.loss_critic + i, out.loss_mse)
+    assert int(mem.count) == 8 and int(mem.ptr) == 2
+
+    p2, s2, loss = replay_apply(mem, params, opt_state, opt, jax.random.PRNGKey(0), batch=4)
+    assert np.isfinite(float(loss))
+    d0 = np.asarray(params["cheb_0"]["kernel"])
+    d1 = np.asarray(p2["cheb_0"]["kernel"])
+    assert not np.allclose(d0, d1)
+    # max-norm constraint holds after updates (keras axis-0 norms)
+    for layer in p2.values():
+        for w in layer.values():
+            norms = np.sqrt((np.asarray(w) ** 2).sum(axis=0))
+            assert (norms <= 1.0 + 1e-6).all()
+
+
+def test_max_norm_constraint_matches_keras_formula():
+    w = jnp.asarray(np.array([[3.0, 0.1], [4.0, 0.1]]))  # col norms 5, ~0.14
+    out = np.asarray(apply_max_norm_constraint({"k": w}, 1.0)["k"])
+    norms = np.sqrt((np.array([[3.0, 0.1], [4.0, 0.1]]) ** 2).sum(axis=0))
+    expect = np.array([[3.0, 0.1], [4.0, 0.1]]) * (
+        np.clip(norms, 0, 1.0) / (1e-7 + norms)
+    )
+    np.testing.assert_allclose(out, expect, rtol=1e-12)
