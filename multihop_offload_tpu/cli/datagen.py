@@ -1,0 +1,115 @@
+"""Dataset generator — the `bash/data_gen_aco.sh` equivalent.
+
+Reimplements `data_generation_offloading.py` (which is broken as shipped: it
+imports a nonexistent module and a removed NetworkX API, SURVEY.md §8):
+BA or Poisson topologies over sizes 20..110, topology-aware role assignment —
+relays on the minimum node cut, servers concentrated on the smaller side of
+the Stoer–Wagner minimum edge cut with sorted Pareto(2)x100 capacities, and
+Pareto(2)x8 mobile compute — written in the reference `.mat` schema.
+
+    python -m multihop_offload_tpu.cli.datagen --datapath=data/aco_data_ba_100 \
+        --gtype=ba --size=100 --seed=500
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import networkx as nx
+import numpy as np
+
+from multihop_offload_tpu.graphs import generators
+from multihop_offload_tpu.graphs.matio import save_case_mat
+
+GRAPH_SIZES = [20, 30, 40, 50, 60, 70, 80, 90, 100, 110]
+
+
+def assign_roles(
+    graph: nx.Graph, num_servers: int, rng: np.random.Generator
+) -> np.ndarray:
+    """(N, 2) nodes_info = [role, proc_bw] (`data_generation_offloading.py:88-133`)."""
+    n = graph.number_of_nodes()
+    relay_set = set(nx.minimum_node_cut(graph))
+    _, partition = nx.stoer_wagner(graph)
+    nodes_info = np.zeros((n, 2), dtype=np.int64)
+    for idx in relay_set:
+        nodes_info[idx] = [2, 0]
+
+    sides = [
+        list(rng.permutation(list(set(partition[0]) - relay_set)).astype(int)),
+        list(rng.permutation(list(set(partition[1]) - relay_set)).astype(int)),
+    ]
+    server_side = 1 if len(sides[0]) >= len(sides[1]) else 0
+
+    def place_servers(nodes, count):
+        bws = np.flip(np.sort((rng.pareto(2.0, count) + 1) * 100))
+        for i in range(count):
+            nodes_info[nodes[i]] = [1, int(bws[i])]
+
+    far = sides[server_side]
+    near = sides[1 - server_side]
+    if num_servers >= len(far):
+        place_servers(far, len(far))
+        spill = num_servers - len(far)
+        if spill:
+            bws = (rng.pareto(2.0, spill) + 1) * 100
+            for i in range(spill):
+                nodes_info[near[i]] = [1, int(bws[i])]
+        mobile = near[spill:]
+    else:
+        place_servers(far, num_servers)
+        # far-side non-servers stay mobile, as do all near-side nodes
+        mobile = near + far[num_servers:]
+    m_bws = (rng.pareto(2.0, len(mobile)) + 1) * 8
+    for i, idx in enumerate(mobile):
+        nodes_info[idx] = [0, int(m_bws[i])]
+    return nodes_info
+
+
+def generate_dataset(
+    datapath: str, gtype: str = "ba", size: int = 100, seed0: int = 500,
+    m: int = 2, graph_sizes=None, verbose: bool = True,
+):
+    os.makedirs(datapath, exist_ok=True)
+    written = []
+    for sid in range(size):
+        seed = seed0 + sid
+        rng = np.random.default_rng(seed)
+        for num_nodes in graph_sizes or GRAPH_SIZES:
+            if gtype == "poisson":
+                adj, pos, m_eff = generators.connected_poisson_disk(num_nodes, seed=seed)
+            else:
+                adj, _ = generators.generate(gtype, num_nodes, seed=seed, m=m)
+                pos = generators.spring_positions(adj, seed=seed)
+                m_eff = m
+            graph = nx.from_numpy_array(adj)
+            num_links = graph.number_of_edges()
+            num_servers = round(int(rng.integers(10, 25)) / 100 * num_nodes)
+            link_rates = rng.uniform(30, 70, num_links)
+            nodes_info = assign_roles(graph, num_servers, rng)
+            fname = f"aco_case_seed{seed}_m{m_eff}_n{num_nodes}_s{num_servers}.mat"
+            path = os.path.join(datapath, fname)
+            save_case_mat(
+                path, adj, link_rates, nodes_info, pos,
+                seed=seed, m=int(m_eff), gtype=gtype,
+            )
+            written.append(path)
+            if verbose:
+                print("wrote", path)
+    return written
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--datapath", default="data/aco_data_ba_100", type=str)
+    p.add_argument("--gtype", default="ba", type=str)
+    p.add_argument("--size", default=100, type=int)
+    p.add_argument("--seed", default=500, type=int)
+    p.add_argument("--m", default=2, type=int)
+    args = p.parse_args(argv)
+    generate_dataset(args.datapath, args.gtype.lower(), args.size, args.seed, args.m)
+
+
+if __name__ == "__main__":
+    main()

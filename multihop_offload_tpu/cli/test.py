@@ -1,0 +1,20 @@
+"""Evaluation entry point — the `bash/test.sh` equivalent.
+
+    python -m multihop_offload_tpu.cli.test --datapath=data/aco_data_ba_100 \
+        --arrival_scale=0.15 --training_set=BAT800 --T=1000
+"""
+
+from __future__ import annotations
+
+from multihop_offload_tpu.config import from_args
+from multihop_offload_tpu.train.driver import Evaluator
+
+
+def main(argv=None):
+    cfg = from_args(argv)
+    csv = Evaluator(cfg).run()
+    print(f"test results written to {csv}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,24 @@
+"""Training entry point — the `bash/train.sh` equivalent.
+
+    python -m multihop_offload_tpu.cli.train --datapath=data/aco_data_ba_200 \
+        --arrival_scale=0.15 --learning_rate=1e-6 --training_set=BAT800 --T=800
+"""
+
+from __future__ import annotations
+
+from multihop_offload_tpu.config import from_args
+from multihop_offload_tpu.train.driver import Trainer
+
+
+def main(argv=None):
+    cfg = from_args(argv)
+    trainer = Trainer(cfg)
+    restored = trainer.try_restore()
+    if restored is not None:
+        print(f"resumed from orbax step {restored}")
+    csv = trainer.run()
+    print(f"training log written to {csv}")
+
+
+if __name__ == "__main__":
+    main()

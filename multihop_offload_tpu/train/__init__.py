@@ -1,0 +1,8 @@
+from multihop_offload_tpu.train.data import DatasetCache, sample_jobsets  # noqa: F401
+from multihop_offload_tpu.train.metrics import instance_metrics  # noqa: F401
+from multihop_offload_tpu.train.driver import Trainer, Evaluator  # noqa: F401
+from multihop_offload_tpu.train.checkpoints import (  # noqa: F401
+    save_checkpoint,
+    restore_checkpoint,
+    latest_step,
+)

@@ -1,0 +1,48 @@
+"""Orbax checkpointing of the full training state.
+
+Improves on the reference, which saves only model weights
+(`gnn_offloading_agent.py:125-132`) and silently loses optimizer state and
+replay memory on resume (SURVEY.md §5.4): we checkpoint params + optimizer
+state + step + RNG seed state; the TF-format weight export for reference
+interop lives in `models.tf_import.save_reference_checkpoint`.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+
+def _manager(directory: str) -> ocp.CheckpointManager:
+    return ocp.CheckpointManager(
+        os.path.abspath(directory),
+        options=ocp.CheckpointManagerOptions(max_to_keep=3, create=True),
+    )
+
+
+def save_checkpoint(directory: str, step: int, state: Any) -> None:
+    """state: any pytree (params / opt_state / counters)."""
+    with _manager(directory) as mgr:
+        mgr.save(step, args=ocp.args.StandardSave(state))
+        mgr.wait_until_finished()
+
+
+def latest_step(directory: str) -> Optional[int]:
+    if not os.path.isdir(directory):
+        return None
+    with _manager(directory) as mgr:
+        return mgr.latest_step()
+
+
+def restore_checkpoint(directory: str, abstract_state: Any, step: Optional[int] = None):
+    """Restore into the structure/shapes/dtypes of `abstract_state`."""
+    with _manager(directory) as mgr:
+        step = step if step is not None else mgr.latest_step()
+        if step is None:
+            return None
+        target = jax.tree_util.tree_map(np.asarray, abstract_state)
+        return mgr.restore(step, args=ocp.args.StandardRestore(target))

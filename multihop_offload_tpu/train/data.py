@@ -1,0 +1,95 @@
+"""Dataset cache and workload sampling.
+
+The reference reloads each `.mat` and rebuilds its NetworkX environment every
+epoch visit (`AdHoc_train.py:84-110`); we parse each case once, keep the
+frozen topology arrays, and per visit only re-realize the noisy link
+capacities (`links_init` semantics) and refresh the affected Instance fields.
+Workload sampling mirrors `AdHoc_train.py:112-121` but is seeded — the
+reference's job draws use the unseeded global NumPy RNG, which is why its
+runs are not exactly reproducible (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from multihop_offload_tpu.config import Config
+from multihop_offload_tpu.graphs.instance import (
+    Instance,
+    JobSet,
+    PadSpec,
+    build_instance,
+    build_jobset,
+    stack_instances,
+)
+from multihop_offload_tpu.graphs.matio import CaseRecord, list_dataset, load_case_mat
+
+
+@dataclasses.dataclass
+class DatasetCache:
+    cfg: Config
+    records: List[CaseRecord]
+    pad: PadSpec
+
+    @classmethod
+    def load(cls, cfg: Config, datapath: Optional[str] = None) -> "DatasetCache":
+        datapath = datapath or cfg.datapath
+        names = list_dataset(datapath)
+        if not names:
+            raise FileNotFoundError(f"no .mat cases under {datapath}")
+        records = [load_case_mat(os.path.join(datapath, n)) for n in names]
+        pad = PadSpec(
+            n=cfg.pad_nodes or PadSpec.round_up(max(r.topo.n for r in records), cfg.round_to),
+            l=cfg.pad_links or PadSpec.round_up(max(r.topo.num_links for r in records), cfg.round_to),
+            s=cfg.pad_servers or PadSpec.round_up(max(r.num_servers for r in records), cfg.round_to),
+            j=cfg.pad_jobs or PadSpec.round_up(max(r.mobile_nodes.size for r in records), cfg.round_to),
+        )
+        return cls(cfg=cfg, records=records, pad=pad)
+
+    def __len__(self) -> int:
+        return len(self.records)
+
+    def instance(self, idx: int, rng: np.random.Generator) -> Instance:
+        """Freeze case `idx` with freshly realized link capacities
+        (`links_init` noise is re-drawn every visit, as in the reference)."""
+        rec = self.records[idx]
+        from multihop_offload_tpu.graphs.topology import sample_link_rates
+
+        rates = sample_link_rates(rec.topo, rec.link_rates, rng=rng)
+        return build_instance(
+            rec.topo, rec.roles, rec.proc_bws, rates,
+            float(self.cfg.T), self.pad, dtype=self.cfg.jnp_dtype,
+        )
+
+
+def sample_jobsets(
+    rec: CaseRecord,
+    pad: PadSpec,
+    num_instances: int,
+    rng: np.random.Generator,
+    arrival_scale: float,
+    ul: float = 100.0,
+    dl: float = 1.0,
+    dtype=np.float32,
+) -> tuple:
+    """`num_instances` independent workloads on one network, stacked for vmap.
+
+    Per instance (`AdHoc_train.py:113-121`): jobs on a random 30-100% subset
+    of mobile nodes, arrival rates U(0.1, 0.5) * arrival_scale.
+    """
+    sets: List[JobSet] = []
+    counts = []
+    for _ in range(num_instances):
+        mobile = rng.permutation(rec.mobile_nodes)
+        lo = int(0.3 * mobile.size)
+        nj = int(rng.integers(lo, mobile.size)) if mobile.size > lo else mobile.size
+        rates = arrival_scale * rng.uniform(0.1, 0.5, nj)
+        sets.append(
+            build_jobset(mobile[:nj], rates, pad_jobs=pad.j, ul=ul, dl=dl, dtype=dtype)
+        )
+        counts.append(nj)
+    return stack_instances(sets), np.asarray(counts)

@@ -1,0 +1,41 @@
+"""Per-instance metrics matching the reference's CSV semantics.
+
+`tau` = nanmean of per-job empirical delay, `congest_jobs` = count of jobs
+with delay > T, `gap_2_bl`/`gnn_bl_ratio` = per-job mean difference/ratio
+against the baseline method on the *same* workload
+(`AdHoc_train.py:160-182`, `AdHoc_test.py:156-178`).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class InstanceMetrics:
+    tau: jnp.ndarray          # () mean per-job delay
+    congest_jobs: jnp.ndarray  # () int
+    gap_2_bl: jnp.ndarray     # () mean per-job (delay - baseline delay)
+    ratio_2_bl: jnp.ndarray   # () mean per-job (delay / baseline delay)
+
+
+def _masked_mean(x, mask):
+    denom = jnp.maximum(mask.sum(), 1)
+    return jnp.sum(jnp.where(mask, x, 0.0)) / denom
+
+
+def instance_metrics(
+    job_total: jnp.ndarray,
+    baseline_total: jnp.ndarray,
+    mask: jnp.ndarray,
+    t_max,
+) -> InstanceMetrics:
+    return InstanceMetrics(
+        tau=_masked_mean(job_total, mask),
+        congest_jobs=jnp.sum((job_total > t_max) & mask),
+        gap_2_bl=_masked_mean(job_total - baseline_total, mask),
+        ratio_2_bl=_masked_mean(
+            job_total / jnp.where(mask, baseline_total, 1.0), mask
+        ),
+    )
