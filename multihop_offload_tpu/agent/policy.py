@@ -25,12 +25,14 @@ def forward_env(
     support: jnp.ndarray | None = None,
     explore=0.0,
     prob: bool = False,
+    apsp_fn=None,
 ) -> tuple[PolicyOutcome, ActorOutput]:
     if support is None:
         support = inst.adj_ext  # reference compat: raw ext adjacency
     actor = actor_delay_matrix(model, variables, inst, jobs, support)
     unit_diag = jnp.diagonal(actor.delay_matrix)
     outcome = evaluate_spmatrix_policy(
-        inst, jobs, actor.link_delay, unit_diag, key, explore=explore, prob=prob
+        inst, jobs, actor.link_delay, unit_diag, key,
+        explore=explore, prob=prob, apsp_fn=apsp_fn,
     )
     return outcome, actor

@@ -167,9 +167,11 @@ def forward_backward(
     explore=0.0,
     prob: bool = False,
     mse_weight: float = 0.001,
+    apsp_fn=None,
 ) -> TrainStepOutput:
     if support is None:
         support = inst.adj_ext
+    apsp = apsp_fn or apsp_minplus
 
     # --- 1. actor forward under VJP -------------------------------------
     def actor_fn(params_tree):
@@ -182,8 +184,10 @@ def forward_backward(
     link_delay = lax.stop_gradient(actor.link_delay)
     unit_diag = lax.stop_gradient(jnp.diagonal(dmtx))
     w = weight_matrix_from_link_delays(inst.adj, inst.link_index, link_delay)
-    sp = apsp_minplus(w)
-    hop = hop_matrix(inst.adj)
+    sp = apsp(w)
+    hop = apsp(
+        jnp.where(inst.adj > 0, jnp.ones_like(inst.adj), jnp.full_like(inst.adj, jnp.inf))
+    )
     dec = offload_decide(inst, jobs, sp, hop, unit_diag, key, explore, prob)
     routes = trace_routes(inst, next_hop_table(inst.adj, sp), jobs, dec.dst)
     delays = run_empirical(inst, jobs, routes)

@@ -45,6 +45,7 @@ def evaluate_spmatrix_policy(
     key: jax.Array,
     explore=0.0,
     prob: bool = False,
+    apsp_fn=None,
 ) -> PolicyOutcome:
     """Offload + route + run given per-link unit delays and a node diagonal.
 
@@ -52,11 +53,15 @@ def evaluate_spmatrix_policy(
     (`AdHoc_train.py:128-141`) and the GNN policy (`forward_env`,
     `gnn_offloading_agent.py:278-291`): build the one-hop weight matrix, run
     min-plus APSP + hop counts, take the greedy decision, trace routes, and
-    score empirically.
+    score empirically.  `apsp_fn` overrides the APSP kernel (e.g. the
+    mesh-sharded ring variant from `parallel.ring` for large graphs).
     """
+    apsp = apsp_fn or apsp_minplus
     w = weight_matrix_from_link_delays(inst.adj, inst.link_index, link_delays)
-    sp = apsp_minplus(w)
-    hop = hop_matrix(inst.adj)
+    sp = apsp(w)
+    hop = apsp(
+        jnp.where(inst.adj > 0, jnp.ones_like(inst.adj), jnp.full_like(inst.adj, jnp.inf))
+    )
     dec = offload_decide(inst, jobs, sp, hop, unit_diag, key, explore, prob)
     nh = next_hop_table(inst.adj, sp)
     routes = trace_routes(inst, nh, jobs, dec.dst)

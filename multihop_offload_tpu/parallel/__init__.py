@@ -1,0 +1,10 @@
+from multihop_offload_tpu.parallel.mesh import make_mesh  # noqa: F401
+from multihop_offload_tpu.parallel.ring import (  # noqa: F401
+    ring_minplus_square,
+    sharded_apsp,
+)
+from multihop_offload_tpu.parallel.data_parallel import (  # noqa: F401
+    make_dp_train_step,
+    make_dp_eval_step,
+    make_multichip_train_step,
+)

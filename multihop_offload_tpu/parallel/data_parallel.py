@@ -1,0 +1,156 @@
+"""Multi-chip training/eval steps over the ('data', 'graph') mesh.
+
+The reference is strictly single-process single-device (SURVEY.md §2.8); the
+scaling machinery is new capability.  Episodes (network instances) shard
+across the `data` axis; within each data-parallel group the per-instance
+distance-matrix work can shard across the `graph` axis via the ring APSP.
+
+Two update rules:
+  * `mode="mean"` — modern synchronous DP: psum-mean the per-episode
+    gradients and take one Adam step per call;
+  * `mode="replay"` — the reference's gradient-replay semantics: every
+    device's per-episode gradients are all-gathered and appended to the
+    (replicated) ring buffer; the replay update itself
+    (`agent.replay.replay_apply`) stays a separate program.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from multihop_offload_tpu.agent.replay import (
+    apply_max_norm_constraint,
+    replay_remember,
+)
+from multihop_offload_tpu.agent.train_step import forward_backward
+from multihop_offload_tpu.agent.policy import forward_env
+from multihop_offload_tpu.parallel.ring import sharded_apsp
+
+
+def _graph_apsp_fn(mesh: Mesh):
+    """Ring APSP over the 'graph' axis when it is nontrivial, else None."""
+    if mesh.shape.get("graph", 1) > 1:
+        return lambda w: sharded_apsp(w, "graph")
+    return None
+
+
+def make_dp_train_step(model, optimizer, mesh: Mesh, mode: str = "mean"):
+    """Batched episode step: (variables, opt_state|mem, insts, jobsets, keys,
+    explore) with the episode batch sharded over 'data'.
+
+    Batch axis length must be divisible by the data-axis size.
+    """
+    apsp_fn = _graph_apsp_fn(mesh)
+
+    def per_device(variables, insts, jobsets, keys, explore):
+        outs = jax.vmap(
+            lambda i, jb, k: forward_backward(
+                model, variables, i, jb, k, explore=explore, apsp_fn=apsp_fn
+            )
+        )(insts, jobsets, keys)
+        return outs
+
+    if mode == "mean":
+
+        def step(variables, opt_state, insts, jobsets, keys, explore):
+            outs = per_device(variables, insts, jobsets, keys, explore)
+            grads = jax.tree_util.tree_map(
+                lambda g: lax.pmean(jnp.mean(g, axis=0), "data"), outs.grads
+            )
+            updates, opt_state = optimizer.update(
+                grads["params"], opt_state, variables["params"]
+            )
+            params = optax.apply_updates(variables["params"], updates)
+            params = apply_max_norm_constraint(params, 1.0)
+            metrics = {
+                "loss_critic": lax.pmean(jnp.mean(outs.loss_critic), "data"),
+                "loss_mse": lax.pmean(jnp.mean(outs.loss_mse), "data"),
+                "job_total": lax.all_gather(
+                    outs.delays.job_total, "data", axis=0, tiled=True
+                ),
+            }
+            return {"params": params}, opt_state, metrics
+
+        in_specs = (P(), P(), P("data"), P("data"), P("data"), P())
+        out_specs = (P(), P(), P())
+        return jax.jit(
+            shard_map(
+                step, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_vma=False,
+            )
+        )
+
+    if mode == "replay":
+
+        def step(variables, mem, insts, jobsets, keys, explore):
+            outs = per_device(variables, insts, jobsets, keys, explore)
+            # replicate every device's episode gradients into the ring buffer
+            all_grads = jax.tree_util.tree_map(
+                lambda g: lax.all_gather(g, "data", axis=0, tiled=True),
+                outs.grads["params"],
+            )
+            lc = lax.all_gather(outs.loss_critic, "data", axis=0, tiled=True)
+            lm = lax.all_gather(outs.loss_mse, "data", axis=0, tiled=True)
+
+            def remember(m, i):
+                g = jax.tree_util.tree_map(lambda x: x[i], all_grads)
+                return replay_remember(m, g, lc[i], lm[i]), None
+
+            mem, _ = lax.scan(remember, mem, jnp.arange(lc.shape[0]))
+            metrics = {
+                "loss_critic": lc,
+                "loss_mse": lm,
+                "job_total": lax.all_gather(
+                    outs.delays.job_total, "data", axis=0, tiled=True
+                ),
+            }
+            return mem, metrics
+
+        in_specs = (P(), P(), P("data"), P("data"), P("data"), P())
+        out_specs = (P(), P())
+        return jax.jit(
+            shard_map(
+                step, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_vma=False,
+            ),
+            donate_argnums=(1,),
+        )
+
+    raise ValueError(f"unknown mode {mode!r}")
+
+
+def make_dp_eval_step(model, mesh: Mesh):
+    """Data-parallel policy evaluation (inference): job totals for a sharded
+    episode batch."""
+    apsp_fn = _graph_apsp_fn(mesh)
+
+    def step(variables, insts, jobsets, keys):
+        totals = jax.vmap(
+            lambda i, jb, k: forward_env(
+                model, variables, i, jb, k, apsp_fn=apsp_fn
+            )[0].job_total
+        )(insts, jobsets, keys)
+        return lax.all_gather(totals, "data", axis=0, tiled=True)
+
+    return jax.jit(
+        shard_map(
+            step, mesh=mesh,
+            in_specs=(P(), P("data"), P("data"), P("data")),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+
+
+def make_multichip_train_step(model, optimizer, mesh: Mesh):
+    """The full multi-chip training step used by `dryrun_multichip`: episode
+    batch over 'data', ring-sharded APSP over 'graph', psum-mean update."""
+    return make_dp_train_step(model, optimizer, mesh, mode="mean")

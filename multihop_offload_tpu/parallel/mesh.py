@@ -1,0 +1,34 @@
+"""Device meshes for the framework's two parallel axes.
+
+The workload's natural scaling axes (SURVEY.md §2.8, §5.7):
+  `data`  — independent network instances (episodes): pure data parallelism
+            with gradient all-reduce/all-gather over ICI;
+  `graph` — rows of a single large graph's distance matrix: the min-plus
+            APSP ring (`parallel.ring`), the sparse-propagation analogue of
+            sequence parallelism, for beyond-paper-scale networks
+            (BASELINE.json config 5).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    data: Optional[int] = None,
+    graph: int = 1,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    if data is None:
+        data = len(devices) // graph
+    if data * graph > len(devices):
+        raise ValueError(
+            f"mesh {data}x{graph} needs {data * graph} devices, have {len(devices)}"
+        )
+    grid = np.asarray(devices[: data * graph]).reshape(data, graph)
+    return Mesh(grid, axis_names=("data", "graph"))

@@ -1,0 +1,88 @@
+"""Ring-sharded min-plus APSP — distance-matrix parallelism over a mesh axis.
+
+For beyond-paper-scale networks (~1000+ nodes, BASELINE.json config 5) the
+dense (N, N, N) min-plus squaring of `env.apsp` outgrows one chip.  Here the
+distance matrix is split into row blocks across a mesh axis and each squaring
+step streams the blocks around the ring with `lax.ppermute` — the classic
+ring-matmul schedule in the (min, +) semiring, the sparse-propagation
+analogue of ring attention: every device overlaps compute on the block it
+holds with the neighbor exchange of the next block over ICI.
+
+All functions run inside `shard_map` with `axis_name` bound.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _block_minplus(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """(n, k) x (k, m) min-plus product."""
+    return jnp.min(a[:, :, None] + b[None, :, :], axis=1)
+
+
+def ring_minplus_square(d_rows: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """One squaring D <- D (x) D with D row-sharded: d_rows is this device's
+    (n_local, N) block.  n_dev ring steps; step s works on the row block
+    originally owned by (idx + s) mod n_dev while the next block is in
+    flight."""
+    n_dev = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    n_local = d_rows.shape[0]
+    perm = [(i, (i - 1) % n_dev) for i in range(n_dev)]
+
+    def step(carry, s):
+        out, block = carry
+        owner = ((idx + s) % n_dev).astype(jnp.int32)
+        cols = lax.dynamic_slice(
+            d_rows, (jnp.int32(0), owner * jnp.int32(n_local)), (n_local, n_local)
+        )
+        out = jnp.minimum(out, _block_minplus(cols, block))
+        block = lax.ppermute(block, axis_name, perm)
+        return (out, block), None
+
+    init = (jnp.full_like(d_rows, jnp.inf), d_rows)
+    (out, _), _ = lax.scan(step, init, jnp.arange(n_dev))
+    return out
+
+
+def ring_apsp_rows(
+    w_rows: jnp.ndarray, axis_name: str, n_total: int, num_iters: int | None = None
+) -> jnp.ndarray:
+    """APSP on a row-sharded one-hop weight matrix; returns sharded rows.
+
+    The diagonal of the full matrix is zeroed (only this device's diagonal
+    entries fall inside its block).
+    """
+    idx = lax.axis_index(axis_name)
+    n_local = w_rows.shape[0]
+    row_ids = idx * n_local + jnp.arange(n_local)
+    col = jax.nn.one_hot(row_ids, n_total, dtype=bool)
+    d = jnp.where(col, 0.0, w_rows)
+    iters = num_iters or max(1, math.ceil(math.log2(max(n_total - 1, 2))))
+    for _ in range(iters):
+        d = ring_minplus_square(d, axis_name)
+    return d
+
+
+def sharded_apsp(w: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """Drop-in `apsp_fn`: full (N, N) in, full (N, N) out, with the compute
+    row-sharded over `axis_name` and regathered.
+
+    Use inside `shard_map` where `w` is replicated along `axis_name` (e.g.
+    the per-instance pipeline of a data-parallel step whose second mesh axis
+    shards the graph).  N must be divisible by the axis size.
+    """
+    n = w.shape[-1]
+    n_dev = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    n_local = n // n_dev
+    start = (idx * n_local).astype(jnp.int32)
+    rows = lax.dynamic_slice(w, (start, jnp.int32(0)), (n_local, n))
+    d_rows = ring_apsp_rows(rows, axis_name, n)
+    return lax.all_gather(d_rows, axis_name, axis=0).reshape(n, n)
