@@ -1,0 +1,126 @@
+"""North-star benchmark: GNN actor/critic episodes per second.
+
+Measures the batched `forward_backward` step — the exact computation the
+reference times per instance in its drivers (`AdHoc_test.py:150-156`, ~0.11 s
+=> ~9 episodes/sec on its single device, BASELINE.md) — over a vmapped batch
+of real reference test networks (aco_data_ba_100 sizes 20-110, load 0.15) on
+whatever accelerator JAX selects (the TPU chip under the driver).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REFERENCE_EPISODES_PER_SEC = 9.0  # BASELINE.md: ~0.11 s/episode, single device
+REFERENCE_DATA = "/root/reference/data/aco_data_ba_100"
+
+
+def _load_cases(max_cases: int, rng):
+    """Real reference cases when available, else synthetic BA equivalents."""
+    from multihop_offload_tpu.graphs.matio import list_dataset, load_case_mat
+
+    recs = []
+    if os.path.isdir(REFERENCE_DATA):
+        names = list_dataset(REFERENCE_DATA)
+        # spread across sizes: every 10th file cycles n=20..110
+        step = max(1, len(names) // max_cases)
+        for nme in names[::step][:max_cases]:
+            recs.append(load_case_mat(os.path.join(REFERENCE_DATA, nme)))
+    else:
+        from multihop_offload_tpu.cli.datagen import generate_dataset
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as d:
+            generate_dataset(d, "ba", size=max(1, max_cases // 10), seed0=500,
+                             verbose=False)
+            names = list_dataset(d)[:max_cases]
+            recs = [load_case_mat(os.path.join(d, nm)) for nm in names]
+    return recs
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from multihop_offload_tpu.agent import forward_backward
+    from multihop_offload_tpu.graphs.instance import (
+        PadSpec, build_instance, build_jobset, stack_instances,
+    )
+    from multihop_offload_tpu.graphs.topology import sample_link_rates
+    from multihop_offload_tpu.models import ChebNet, load_reference_checkpoint
+
+    num_networks = int(os.environ.get("BENCH_NETWORKS", 16))
+    per_network = int(os.environ.get("BENCH_INSTANCES", 4))
+    arrival_scale = 0.15
+    rng = np.random.default_rng(0)
+    recs = _load_cases(num_networks, rng)
+    pad = PadSpec.for_cases([r.sizes for r in recs], round_to=8)
+
+    insts, jobsets = [], []
+    for rec in recs:
+        rates = sample_link_rates(rec.topo, rec.link_rates, rng=rng)
+        inst = build_instance(
+            rec.topo, rec.roles, rec.proc_bws, rates, 1000.0, pad, np.float32
+        )
+        for _ in range(per_network):
+            mobile = rng.permutation(rec.mobile_nodes)
+            nj = int(rng.integers(max(int(0.3 * mobile.size), 1), mobile.size))
+            jobsets.append(build_jobset(
+                mobile[:nj], arrival_scale * rng.uniform(0.1, 0.5, nj),
+                pad_jobs=pad.j, dtype=np.float32,
+            ))
+            insts.append(inst)
+    binst = stack_instances(insts)
+    bjobs = stack_instances(jobsets)
+    batch = len(insts)
+
+    model = ChebNet(param_dtype=jnp.float32)
+    ckpt = "/root/reference/model/model_ChebConv_BAT800_a5_c5_ACO_agent"
+    if os.path.isdir(ckpt):
+        variables = load_reference_checkpoint(ckpt, dtype=np.float32)
+    else:
+        variables = model.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((pad.e, 4), jnp.float32),
+            jnp.zeros((pad.e, pad.e), jnp.float32),
+        )
+
+    @jax.jit
+    def step(variables, insts, jobs, keys):
+        outs = jax.vmap(
+            lambda i, jb, k: forward_backward(model, variables, i, jb, k,
+                                              explore=0.0)
+        )(insts, jobs, keys)
+        return outs.grads, outs.loss_critic, outs.delays.job_total
+
+    keys = jax.random.split(jax.random.PRNGKey(1), batch)
+    # warmup/compile
+    out = step(variables, binst, bjobs, keys)
+    jax.block_until_ready(out)
+
+    reps = int(os.environ.get("BENCH_REPS", 10))
+    t0 = time.time()
+    for r in range(reps):
+        keys = jax.random.split(jax.random.PRNGKey(2 + r), batch)
+        out = step(variables, binst, bjobs, keys)
+    jax.block_until_ready(out)
+    dt = time.time() - t0
+
+    eps = batch * reps / dt
+    print(json.dumps({
+        "metric": "gnn_actor_critic_episodes_per_sec",
+        "value": round(eps, 2),
+        "unit": "episodes/sec/chip",
+        "vs_baseline": round(eps / REFERENCE_EPISODES_PER_SEC, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,39 @@
+"""Figure regeneration CLI — the analysis-notebook equivalent.
+
+    python -m multihop_offload_tpu.cli.plot out/Adhoc_test_data_*.csv --out fig/
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+
+from multihop_offload_tpu.train.analysis import (
+    overall_table,
+    plot_test_figures,
+    plot_training_monitor,
+)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("csvs", nargs="+", help="result CSVs (test or training)")
+    p.add_argument("--out", default="fig", type=str)
+    args = p.parse_args(argv)
+    import pandas as pd
+
+    for pattern in args.csvs:
+        for path in sorted(glob.glob(pattern)):
+            name = os.path.basename(path)
+            if name.startswith("aco_training_data"):
+                out = plot_training_monitor(path, args.out)
+                print("wrote", out)
+            else:
+                for out in plot_test_figures(path, args.out):
+                    print("wrote", out)
+                print(overall_table(pd.read_csv(path)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,71 @@
+"""Node mobility: position jitter + topology rebuild.
+
+The reference's (driver-unused but public) mobility support:
+`AdhocCloud.random_walk` (`offloading_v3.py:80-97`) jitters a random subset
+of node positions until unit-disk connectivity holds, and `topology_update`
+(`:99-129`) rebuilds the conflict structure returning an old->new link map so
+per-link state can migrate.  Host-side NumPy, producing fresh Topology arrays
+for the device pipeline; the old->new map is expressed on canonical link ids.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from multihop_offload_tpu.graphs.generators import unit_disk_adjacency
+from multihop_offload_tpu.graphs.topology import Topology, build_topology
+
+
+def random_walk(
+    pos: np.ndarray,
+    n_moving: int = 10,
+    step_std: float = 0.1,
+    radius: float = 1.0,
+    bounds: Optional[Tuple[float, float]] = None,
+    rng: Optional[np.random.Generator] = None,
+    max_tries: int = 1000,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Jitter `n_moving` random nodes by N(0, step_std) until the unit-disk
+    graph stays connected; returns (new_pos, new_adj)."""
+    rng = rng or np.random.default_rng()
+    n = pos.shape[0]
+    lo, hi = bounds if bounds is not None else (pos.min(), pos.max())
+    for _ in range(max_tries):
+        moving = rng.choice(n, size=min(n_moving, n), replace=False)
+        cand = pos.copy()
+        cand[moving] += rng.normal(0.0, step_std, (moving.size, 2))
+        cand = cand.clip(lo, hi)
+        adj = unit_disk_adjacency(cand, radius)
+        if build_topology(adj).connected:
+            return cand, adj
+    raise RuntimeError("random_walk: no connected perturbation found")
+
+
+def topology_update(
+    old: Topology, new_adj: np.ndarray, pos: Optional[np.ndarray] = None,
+    cf_radius: float = 0.0,
+) -> Tuple[Topology, np.ndarray]:
+    """Rebuild topology arrays after mobility; returns (new_topo, link_map)
+    with link_map[i] = old canonical id of new link i, or -1 if the link is
+    new (`offloading_v3.py:104-116` semantics on canonical ids)."""
+    new_topo = build_topology(new_adj, pos=pos, cf_radius=cf_radius)
+    link_map = np.full((new_topo.num_links,), -1, dtype=np.int64)
+    for i, (u, v) in enumerate(new_topo.link_ends):
+        if u < old.n and v < old.n:
+            j = old.link_index[u, v]
+            if j >= 0:
+                link_map[i] = j
+    return new_topo, link_map
+
+
+def migrate_link_state(
+    link_map: np.ndarray, old_state: np.ndarray, fill=0.0
+) -> np.ndarray:
+    """Carry per-link arrays (rates, queues) across a topology update."""
+    new_state = np.full((link_map.shape[0],) + old_state.shape[1:], fill,
+                        dtype=old_state.dtype)
+    keep = link_map >= 0
+    new_state[keep] = old_state[link_map[keep]]
+    return new_state

@@ -1,0 +1,112 @@
+"""Results analysis — the `results_plot-Adhoc.ipynb` equivalent as a module.
+
+Regenerates the paper-figure views from result CSVs (ours or the reference's
+shipped `out/*.csv` — identical schemas): mean per-task latency tau by
+network size and method (Fig. 2(a)), congested-task ratio by size (Fig. 2(b)),
+per-instance runtime by method (Fig. 2(c)), and the live-training monitor
+(rolling tau per method over file index, notebook cell 5).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+import pandas as pd
+
+
+def _algo_col(df: pd.DataFrame) -> str:
+    return "Algo" if "Algo" in df.columns else "method"
+
+
+def summarize_test(df: pd.DataFrame) -> pd.DataFrame:
+    """Per (num_nodes, method) aggregates of tau / congestion / runtime."""
+    algo = _algo_col(df)
+    d = df.copy()
+    d["congest_ratio"] = d["congest_jobs"] / d["num_jobs"].clip(lower=1)
+    return (
+        d.groupby(["num_nodes", algo])
+        .agg(
+            tau=("tau", "mean"),
+            congest_ratio=("congest_ratio", "mean"),
+            runtime=("runtime", "mean"),
+            ratio_vs_baseline=("gnn_bl_ratio", "mean"),
+        )
+        .reset_index()
+    )
+
+
+def overall_table(df: pd.DataFrame) -> pd.DataFrame:
+    """Whole-set means per method — the BASELINE.md comparison table."""
+    algo = _algo_col(df)
+    d = df.copy()
+    d["congest_ratio"] = d["congest_jobs"] / d["num_jobs"].clip(lower=1)
+    return d.groupby(algo).agg(
+        tau=("tau", "mean"),
+        congest_ratio=("congest_ratio", "mean"),
+        runtime=("runtime", "mean"),
+    )
+
+
+def plot_test_figures(csv_path: str, out_dir: str = "fig") -> list:
+    """Fig. 2(a-c) equivalents from a test CSV."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    df = pd.read_csv(csv_path)
+    algo = _algo_col(df)
+    s = summarize_test(df)
+    os.makedirs(out_dir, exist_ok=True)
+    tag = os.path.splitext(os.path.basename(csv_path))[0]
+    written = []
+    panels = [
+        ("tau", "mean per-task latency tau", "fig2a"),
+        ("congest_ratio", "congested-task ratio", "fig2b"),
+        ("runtime", "mean per-instance runtime (s)", "fig2c"),
+    ]
+    for col, ylabel, name in panels:
+        fig, ax = plt.subplots(figsize=(5, 3.4))
+        for method, grp in s.groupby(algo):
+            ax.plot(grp["num_nodes"], grp[col], marker="o", label=str(method))
+        ax.set_xlabel("network size (nodes)")
+        ax.set_ylabel(ylabel)
+        if col == "tau":
+            ax.set_yscale("log")
+        ax.legend()
+        fig.tight_layout()
+        path = os.path.join(out_dir, f"{name}_{tag}.pdf")
+        fig.savefig(path)
+        plt.close(fig)
+        written.append(path)
+    return written
+
+
+def plot_training_monitor(csv_path: str, out_dir: str = "fig",
+                          window: int = 50) -> str:
+    """Rolling tau per method over training files (notebook cell 5)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    df = pd.read_csv(csv_path)
+    algo = _algo_col(df)
+    os.makedirs(out_dir, exist_ok=True)
+    fig, ax = plt.subplots(figsize=(6, 3.4))
+    for method, grp in df.groupby(algo):
+        grp = grp.sort_values("fid") if "fid" in grp.columns else grp
+        roll = grp["tau"].rolling(window, min_periods=1).mean()
+        ax.plot(np.arange(len(roll)), roll, label=str(method))
+    ax.set_xlabel("instances seen")
+    ax.set_ylabel(f"tau (rolling {window})")
+    ax.set_yscale("log")
+    ax.legend()
+    fig.tight_layout()
+    tag = os.path.splitext(os.path.basename(csv_path))[0]
+    path = os.path.join(out_dir, f"training_monitor_{tag}.pdf")
+    fig.savefig(path)
+    plt.close(fig)
+    return path

@@ -1,0 +1,1 @@
+from multihop_offload_tpu.utils.profiling import phase_timer, trace  # noqa: F401

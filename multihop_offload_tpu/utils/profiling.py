@@ -1,0 +1,56 @@
+"""Tracing/profiling utilities.
+
+The reference's only observability is wall-clock spans written into the
+`runtime` CSV column (SURVEY.md §5.1).  Here: named phase timers with
+aggregate stats, and a `jax.profiler` trace context for TensorBoard-viewable
+device profiles.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Dict, Iterator
+
+import jax
+
+_PHASES: Dict[str, list] = defaultdict(list)
+
+
+@contextlib.contextmanager
+def phase_timer(name: str, block: bool = False) -> Iterator[None]:
+    """Accumulate wall-clock spans per phase; `block=True` waits for device
+    work so the span covers execution, not just dispatch."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        if block:
+            jax.effects_barrier()
+        _PHASES[name].append(time.perf_counter() - t0)
+
+
+def phase_stats() -> Dict[str, dict]:
+    out = {}
+    for name, spans in _PHASES.items():
+        out[name] = {
+            "count": len(spans),
+            "total_s": sum(spans),
+            "mean_s": sum(spans) / len(spans),
+        }
+    return out
+
+
+def reset_phases() -> None:
+    _PHASES.clear()
+
+
+@contextlib.contextmanager
+def trace(logdir: str) -> Iterator[None]:
+    """Device profile trace (view with TensorBoard's profile plugin)."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,87 @@
+"""Network/route visualization.
+
+Equivalents of `util.vis_network`/`vis_edges` (`util.py:53-98`) and
+`AdhocCloud.plot_routes` (`offloading_v3.py:552-586`): draw the connectivity
+graph with mobile sources as red diamonds, servers as blue squares, edge
+widths proportional to realized link delay, node sizes to compute delay.
+The reference's `plot_metrics` reads attributes that are never set
+(SURVEY.md §8) and has no working equivalent to reproduce.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+from multihop_offload_tpu.graphs.topology import Topology
+
+
+def draw_network(
+    topo: Topology,
+    pos: np.ndarray,
+    src_nodes: Sequence[int],
+    dst_nodes: Sequence[int],
+    edge_weights: Optional[np.ndarray] = None,
+    node_delays: Optional[np.ndarray] = None,
+    with_labels: bool = True,
+    ax=None,
+):
+    import matplotlib.pyplot as plt
+    import networkx as nx
+
+    g = nx.from_numpy_array(topo.adj)
+    n = topo.n
+    colors = ["y"] * n
+    sizes = np.full(n, 300.0)
+    if node_delays is not None:
+        sizes = (np.asarray(node_delays) / 5.0) ** 2 + 20.0
+    for s in src_nodes:
+        colors[s] = "r"
+        sizes[s] = max(sizes[s], 200.0)
+    for d in dst_nodes:
+        colors[d] = "b"
+        sizes[d] = 200.0
+
+    if edge_weights is None:
+        widths = 1.0
+        edge_colors = "k"
+    else:
+        # edge order of nx.from_numpy_array = canonical (u<v lexicographic)
+        w = np.asarray(edge_weights)
+        widths = list(w / 10.0 + 1.0)
+        edge_colors = ["g" if x > 0.99 else "k" for x in widths]
+
+    pos_dict = {i: pos[i] for i in range(n)}
+    nx.draw(
+        g, pos=pos_dict, node_color=colors, node_size=list(sizes),
+        width=widths, edge_color=edge_colors, with_labels=with_labels, ax=ax,
+    )
+    return plt.gca() if ax is None else ax
+
+
+def plot_routes(
+    topo: Topology,
+    pos: np.ndarray,
+    servers: Sequence[int],
+    job_srcs: Sequence[int],
+    link_delay_sums: np.ndarray,   # (L,) per-link total realized delay
+    node_delay_sums: np.ndarray,   # (N,) per-node total compute delay
+    out_path: str,
+    with_labels: bool = True,
+):
+    """Route/load visualization (`plot_routes`, `offloading_v3.py:552-586`)."""
+    import matplotlib.pyplot as plt
+
+    weights = np.nan_to_num(np.asarray(link_delay_sums))
+    delays = np.nan_to_num(np.asarray(node_delay_sums)) * 100.0
+    draw_network(
+        topo, pos, list(job_srcs), list(servers),
+        edge_weights=weights, node_delays=delays, with_labels=with_labels,
+    )
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    plt.subplots_adjust(left=0.01, right=0.99, top=0.99, bottom=0.01)
+    plt.savefig(out_path, dpi=300, bbox_inches="tight")
+    plt.close()
+    return out_path

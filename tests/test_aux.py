@@ -1,0 +1,110 @@
+"""Auxiliary subsystems: mobility, visualization, analysis, profiling."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from multihop_offload_tpu.graphs import generators
+from multihop_offload_tpu.graphs.mobility import (
+    migrate_link_state,
+    random_walk,
+    topology_update,
+)
+from multihop_offload_tpu.graphs.topology import build_topology
+from multihop_offload_tpu.train.analysis import (
+    overall_table,
+    plot_test_figures,
+    plot_training_monitor,
+    summarize_test,
+)
+from multihop_offload_tpu.utils.profiling import phase_stats, phase_timer, reset_phases
+
+
+def test_random_walk_preserves_connectivity(rng):
+    adj, pos, _ = generators.connected_poisson_disk(30, seed=9)
+    new_pos, new_adj = random_walk(pos, n_moving=5, step_std=0.1, rng=rng)
+    assert build_topology(new_adj).connected
+    assert new_pos.shape == pos.shape
+
+
+def test_topology_update_link_migration(rng):
+    adj, pos, _ = generators.connected_poisson_disk(30, seed=9)
+    old = build_topology(adj)
+    new_pos, new_adj = random_walk(pos, n_moving=5, step_std=0.2, rng=rng)
+    new, link_map = topology_update(old, new_adj, pos=new_pos)
+    # surviving links map back to the same endpoints
+    for i, j in enumerate(link_map):
+        if j >= 0:
+            assert tuple(new.link_ends[i]) == tuple(old.link_ends[j])
+    state = np.arange(old.num_links, dtype=np.float64)
+    migrated = migrate_link_state(link_map, state, fill=-1.0)
+    keep = link_map >= 0
+    np.testing.assert_array_equal(migrated[keep], link_map[keep])
+    assert (migrated[~keep] == -1).all()
+
+
+def _fake_test_csv(tmp_path):
+    rows = []
+    for n_nodes in [20, 30]:
+        for algo in ["baseline", "local", "GNN"]:
+            for ni in range(3):
+                rows.append({
+                    "filename": f"case_{n_nodes}.mat", "seed": 1,
+                    "num_nodes": n_nodes, "m": 2, "num_mobile": 10,
+                    "num_servers": 3, "num_relays": 1, "num_jobs": 5,
+                    "n_instance": ni, "Algo": algo, "runtime": 0.01,
+                    "tau": 10.0 + ni, "congest_jobs": ni % 2,
+                    "gnn_bl_ratio": 1.0, "gap_2_bl": 0.0,
+                })
+    p = str(tmp_path / "Adhoc_test_data_fake.csv")
+    pd.DataFrame(rows).to_csv(p, index=False)
+    return p
+
+
+def test_analysis_figures(tmp_path):
+    p = _fake_test_csv(tmp_path)
+    df = pd.read_csv(p)
+    s = summarize_test(df)
+    assert set(s["Algo"]) == {"baseline", "local", "GNN"}
+    t = overall_table(df)
+    assert "tau" in t.columns and len(t) == 3
+    figs = plot_test_figures(p, out_dir=str(tmp_path / "fig"))
+    assert len(figs) == 3 and all(os.path.isfile(f) for f in figs)
+
+
+def test_training_monitor_plot(tmp_path):
+    rows = []
+    for fid in range(10):
+        for m in ["baseline", "GNN"]:
+            rows.append({"fid": fid, "method": m, "tau": 20 - fid,
+                         "num_jobs": 4, "congest_jobs": 0})
+    p = str(tmp_path / "aco_training_data_fake.csv")
+    pd.DataFrame(rows).to_csv(p, index=False)
+    out = plot_training_monitor(p, out_dir=str(tmp_path / "fig"))
+    assert os.path.isfile(out)
+
+
+def test_plot_routes_writes_file(tmp_path, small_cases):
+    from multihop_offload_tpu.utils.visualization import plot_routes
+
+    rec = small_cases[0]
+    out = plot_routes(
+        rec.topo, rec.topo.pos, np.flatnonzero(rec.roles == 1),
+        rec.mobile_nodes[:3],
+        np.random.default_rng(0).uniform(0, 5, rec.topo.num_links),
+        np.zeros(rec.topo.n),
+        str(tmp_path / "fig" / "routes.png"),
+    )
+    assert os.path.isfile(out)
+
+
+def test_phase_timers():
+    reset_phases()
+    with phase_timer("x"):
+        pass
+    with phase_timer("x"):
+        pass
+    s = phase_stats()
+    assert s["x"]["count"] == 2 and s["x"]["total_s"] >= 0
