@@ -1,0 +1,4 @@
+from multihop_offload_tpu.ops.minplus import (  # noqa: F401
+    apsp_minplus_pallas,
+    minplus_power_kernel_call,
+)

@@ -1,0 +1,42 @@
+"""Pallas min-plus APSP kernel (interpret mode on CPU) vs the XLA version."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from multihop_offload_tpu.env.apsp import apsp_minplus
+from multihop_offload_tpu.ops.minplus import apsp_minplus_pallas
+
+
+def _random_symmetric_weights(rng, n, p=0.1):
+    w = np.full((n, n), np.inf)
+    iu, ju = np.where(np.triu(rng.uniform(size=(n, n)) < p, 1))
+    vals = rng.uniform(0.1, 5.0, iu.size)
+    w[iu, ju] = w[ju, iu] = vals
+    return w
+
+
+@pytest.mark.parametrize("n", [40, 128, 150])
+def test_pallas_apsp_matches_xla(n):
+    rng = np.random.default_rng(n)
+    w = _random_symmetric_weights(rng, n, p=4.0 / n)
+    got = np.asarray(
+        apsp_minplus_pallas(jnp.asarray(w, jnp.float32), interpret=True)
+    )
+    expect = np.asarray(apsp_minplus(jnp.asarray(w, jnp.float32)))
+    finite = np.isfinite(expect)
+    np.testing.assert_allclose(got[finite], expect[finite], rtol=1e-6)
+    assert (np.isinf(got) == np.isinf(expect)).all()
+    assert (np.diag(got) == 0).all()
+
+
+def test_pallas_apsp_batched():
+    rng = np.random.default_rng(0)
+    ws = np.stack([_random_symmetric_weights(rng, 64, 0.1) for _ in range(3)])
+    got = np.asarray(
+        apsp_minplus_pallas(jnp.asarray(ws, jnp.float32), interpret=True)
+    )
+    for b in range(3):
+        expect = np.asarray(apsp_minplus(jnp.asarray(ws[b], jnp.float32)))
+        finite = np.isfinite(expect)
+        np.testing.assert_allclose(got[b][finite], expect[finite], rtol=1e-6)
