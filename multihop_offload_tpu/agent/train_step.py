@@ -167,6 +167,7 @@ def forward_backward(
     explore=0.0,
     prob: bool = False,
     mse_weight: float = 0.001,
+    critic_weight: float = 1.0,
     apsp_fn=None,
 ) -> TrainStepOutput:
     if support is None:
@@ -198,8 +199,10 @@ def forward_backward(
     )(routes.inc_ext)
 
     # --- 4. suffix-bias gradient onto unit delays -----------------------
+    # (critic_weight scales the reference's policy-sensitivity term; 1.0 is
+    # reference behavior, 0.0 trains on the MSE supervision alone)
     grad_edge = _suffix_bias_grad(inst, jobs, routes, grad_routes)
-    grad_dist = _grad_edge_to_distance(inst, grad_edge)
+    grad_dist = critic_weight * _grad_edge_to_distance(inst, grad_edge)
 
     # --- 5. MSE supervision on written entries (`:440-444`) -------------
     emp = delays.unit_matrix

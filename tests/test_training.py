@@ -1,0 +1,150 @@
+"""Learning-dynamics smoke tests: the full actor/critic/replay loop moves the
+policy in the right direction, and the real (K>=2) spectral GNN trains too."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from multihop_offload_tpu.config import Config
+from multihop_offload_tpu.agent import (
+    forward_backward,
+    forward_env,
+    make_optimizer,
+    replay_apply,
+    replay_init,
+    replay_remember,
+)
+from multihop_offload_tpu.models import ChebNet, chebyshev_support
+
+import __graft_entry__ as graft
+
+
+@pytest.fixture(scope="module")
+def world():
+    binst, bjobs, pad = graft._make_batch(
+        num_cases=6, n_nodes=24, pad_round=8, dtype=np.float64, seed=11
+    )
+    return binst, bjobs, pad
+
+
+def _mean_tau(model, variables, binst, bjobs, key, support_fn=None):
+    def one(i, jb, k):
+        support = support_fn(i) if support_fn else None
+        out, _ = forward_env(model, variables, i, jb, k, support=support)
+        tot = out.delays.job_total
+        return jnp.sum(jnp.where(jb.mask, tot, 0.0)) / jnp.maximum(jb.mask.sum(), 1)
+
+    keys = jax.random.split(key, bjobs.src.shape[0])
+    return float(jnp.mean(jax.vmap(one)(binst, bjobs, keys)))
+
+
+def test_mse_supervision_descends(world):
+    """With the policy-sensitivity term off (critic_weight=0), the training
+    step is supervised regression of the predicted unit-delay matrix onto the
+    empirical one — repeated updates on a fixed workload must reduce the MSE."""
+    binst, bjobs, pad = world
+    model = ChebNet(num_layer=3, hidden=16, param_dtype=jnp.float64)
+    variables = model.init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((pad.e, 4), jnp.float64),
+        jnp.zeros((pad.e, pad.e), jnp.float64),
+    )
+    import optax
+
+    opt = optax.adam(3e-3)
+    opt_state = opt.init(variables["params"])
+    i0 = jax.tree_util.tree_map(lambda x: x[0], binst)
+    jb0 = jax.tree_util.tree_map(lambda x: x[0], bjobs)
+    step = jax.jit(
+        lambda v, k: forward_backward(
+            model, v, i0, jb0, k, explore=0.0, mse_weight=1.0, critic_weight=0.0
+        )
+    )
+    key = jax.random.PRNGKey(5)
+    mses = []
+    for _ in range(25):
+        out = step(variables, key)
+        mses.append(float(out.loss_mse))
+        updates, opt_state = opt.update(out.grads["params"], opt_state)
+        import optax as _o
+
+        variables = {"params": _o.apply_updates(variables["params"], updates)}
+    assert np.isfinite(mses).all()
+    # the optimizer recovers from the first-step transient and drives the
+    # regression loss far below its peak
+    assert min(mses[-5:]) < 0.1 * max(mses)
+
+
+def test_replay_training_loop_runs(world):
+    """The full reference-style loop (memorize + sampled sequential replay)
+    stays finite and moves the weights (`AdHoc_train.py:187`)."""
+    binst, bjobs, pad = world
+    model = ChebNet(num_layer=3, hidden=16, param_dtype=jnp.float64)
+    variables = model.init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((pad.e, 4), jnp.float64),
+        jnp.zeros((pad.e, pad.e), jnp.float64),
+    )
+    cfg = Config(learning_rate=3e-4, batch=8)
+    opt = make_optimizer(cfg)
+    opt_state = opt.init(variables["params"])
+    mem = replay_init(variables["params"], capacity=64)
+
+    step = jax.jit(
+        lambda v, i, jb, k: forward_backward(model, v, i, jb, k, explore=0.1)
+    )
+    key = jax.random.PRNGKey(1)
+    p0 = np.asarray(variables["params"]["cheb_0"]["kernel"]).copy()
+    losses = []
+    count = 0
+    for it in range(6):
+        keys = jax.random.split(jax.random.PRNGKey(100 + it), 6)
+        round_losses = []
+        for b in range(6):
+            i = jax.tree_util.tree_map(lambda x: x[b], binst)
+            jb = jax.tree_util.tree_map(lambda x: x[b], bjobs)
+            out = step(variables, i, jb, keys[b])
+            mem = replay_remember(mem, out.grads["params"], out.loss_critic,
+                                  out.loss_mse)
+            count += 1
+            round_losses.append(float(out.loss_critic))
+        losses.append(np.mean(round_losses))
+        if count >= cfg.batch:
+            key, k = jax.random.split(key)
+            params, opt_state, _ = replay_apply(
+                mem, variables["params"], opt_state, opt, k, batch=cfg.batch
+            )
+            variables = {"params": params}
+    assert np.isfinite(losses).all()
+    assert not np.allclose(p0, np.asarray(variables["params"]["cheb_0"]["kernel"]))
+
+
+def test_k2_spectral_gnn_trains(world):
+    """The real ChebConv (K=2, rescaled-Laplacian support) produces finite,
+    nonzero, adjacency-dependent gradients through the full pipeline."""
+    binst, bjobs, pad = world
+    model = ChebNet(num_layer=3, hidden=16, k=2, param_dtype=jnp.float64)
+    i0 = jax.tree_util.tree_map(lambda x: x[0], binst)
+    jb0 = jax.tree_util.tree_map(lambda x: x[0], bjobs)
+    support = chebyshev_support(i0.adj_ext, i0.ext_mask)
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((pad.e, 4), jnp.float64), support
+    )
+    out = forward_backward(
+        model, variables, i0, jb0, jax.random.PRNGKey(2), support=support
+    )
+    flat, _ = jax.flatten_util.ravel_pytree(out.grads)
+    assert np.isfinite(np.asarray(flat)).all() and np.abs(np.asarray(flat)).sum() > 0
+    # K=2 kernels carry gradient on the T1 (adjacency) term as well
+    g1 = np.asarray(out.grads["params"]["cheb_0"]["kernel"])[1]
+    assert np.abs(g1).sum() > 0
+    # and the support actually changes the prediction (unlike K=1)
+    _, actor_a = forward_env(model, variables, i0, jb0, jax.random.PRNGKey(3),
+                             support=support)
+    _, actor_b = forward_env(model, variables, i0, jb0, jax.random.PRNGKey(3),
+                             support=jnp.zeros_like(support))
+    assert not np.allclose(np.asarray(actor_a.lam), np.asarray(actor_b.lam))
+    tau = _mean_tau(model, variables, binst, bjobs, jax.random.PRNGKey(4),
+                    support_fn=lambda i: chebyshev_support(i.adj_ext, i.ext_mask))
+    assert np.isfinite(tau)
