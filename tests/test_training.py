@@ -131,6 +131,10 @@ def test_k2_spectral_gnn_trains(world):
     variables = model.init(
         jax.random.PRNGKey(0), jnp.zeros((pad.e, 4), jnp.float64), support
     )
+    # lift the output layer out of the dead-ReLU zone so gradients flow
+    params = variables["params"]
+    params["cheb_2"]["bias"] = params["cheb_2"]["bias"] + 1.0
+    variables = {"params": params}
     out = forward_backward(
         model, variables, i0, jb0, jax.random.PRNGKey(2), support=support
     )
