@@ -1,0 +1,26 @@
+#!/bin/bash
+# Dataset generation launcher — reference `bash/data_gen_aco.sh` equivalent
+# (its python target is broken as shipped; ours is `cli.datagen`).
+set -e
+cd "$(dirname "$0")/.."
+
+# Training dataset
+size=200
+seed=100
+for gtype in 'ba'; do  # also: 'er' 'grp' 'ws' 'poisson'
+    datapath="data/aco_data_${gtype}_${size}"
+    echo "generating ${datapath} (training)"
+    python -m multihop_offload_tpu.cli.datagen \
+        --datapath="${datapath}" --gtype="${gtype}" --size="${size}" --seed="${seed}"
+done
+
+# Test dataset
+size=100
+seed=500
+for gtype in 'ba'; do
+    datapath="data/aco_data_${gtype}_${size}"
+    echo "generating ${datapath} (test)"
+    python -m multihop_offload_tpu.cli.datagen \
+        --datapath="${datapath}" --gtype="${gtype}" --size="${size}" --seed="${seed}"
+done
+echo "Done"

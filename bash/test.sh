@@ -1,0 +1,14 @@
+#!/bin/bash
+# Evaluation launcher — reference `bash/test.sh` equivalent (BA-100 test set,
+# load 0.15, T=1000, BAT800 checkpoint).
+set -e
+cd "$(dirname "$0")/.."
+
+size=100
+for scale in 0.15; do
+    datapath="data/aco_data_ba_${size}"
+    echo "evaluating ${datapath} at load ${scale}"
+    python -m multihop_offload_tpu.cli.test --datapath="${datapath}" \
+        --arrival_scale="${scale}" --training_set=BAT800
+done
+echo "Done"

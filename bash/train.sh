@@ -1,0 +1,17 @@
+#!/bin/bash
+# Training launcher — reference `bash/train.sh` equivalent (recipe of record:
+# lr=1e-6, arrival_scale=0.15, T=800, BA-200 training set).
+set -e
+cd "$(dirname "$0")/.."
+
+size=200
+training_set="BAT800"
+T=800
+for gtype in 'ba'; do
+    datapath="data/aco_data_${gtype}_${size}"
+    echo "training on ${datapath}"
+    python -m multihop_offload_tpu.cli.train --datapath="${datapath}" \
+        --arrival_scale=0.15 --learning_rate=0.000001 \
+        --training_set="${training_set}" --T="${T}"
+done
+echo "Done"

@@ -64,6 +64,7 @@ class Config:
     seed: int = 0                  # workload RNG (reference is unseeded)
     mesh_data: int = 1             # data-parallel mesh axis size
     mesh_graph: int = 1            # graph-partition (ring APSP) axis size
+    model_root: str = "model"      # parent dir of checkpoint directories
 
     @property
     def jnp_dtype(self):
@@ -72,12 +73,12 @@ class Config:
         return {"float32": jnp.float32, "float64": jnp.float64,
                 "bfloat16": jnp.bfloat16}[self.dtype]
 
-    def model_dir(self, root: str = "model") -> str:
+    def model_dir(self, root: Optional[str] = None) -> str:
         """Checkpoint directory; naming mirrors `AdHoc_train.py:59`."""
         import os
 
         return os.path.join(
-            root,
+            root if root is not None else self.model_root,
             "model_ChebConv_{}_a{}_c{}_ACO_agent".format(
                 self.training_set, self.num_layer, self.num_layer
             ),

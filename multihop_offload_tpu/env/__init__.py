@@ -18,3 +18,4 @@ from multihop_offload_tpu.env.policies import (  # noqa: F401
     evaluate_spmatrix_policy,
     PolicyOutcome,
 )
+from multihop_offload_tpu.env.scheduling import local_greedy_mwis  # noqa: F401

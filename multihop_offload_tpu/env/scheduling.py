@@ -1,0 +1,57 @@
+"""Distributed link scheduling: local-greedy maximum-weight independent set.
+
+The reference ships `util.local_greedy_search` (`/root/reference/src/util.py:
+12-51`) — the authors' distributed MWIS heuristic for conflict-graph link
+scheduling (its analytic stand-in in the queueing model is the conflict-degree
+service rate, SURVEY.md §2.7).  Here it is a fixed-shape masked fixed point:
+each sweep, every remaining vertex compares its weight against its remaining
+neighbors and joins the set when it strictly wins — or ties and has a lower
+index than the lowest-indexed tied neighbor; winners' neighbors are
+eliminated.  All sweeps are data-parallel (the reference's Python loop over a
+set is order-independent within a sweep), so one sweep is one masked matvec —
+MXU work, `vmap`-able over batches of conflict graphs.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def local_greedy_mwis(
+    adj: jnp.ndarray,
+    wts: jnp.ndarray,
+    mask: jnp.ndarray | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Greedy MWIS on a conflict graph.
+
+    adj:  (L, L) 0/1 adjacency; wts: (L,) vertex weights; mask: (L,) bool
+    active vertices (padding stays out of the set).  Returns (in_set bool
+    (L,), total weight).  Matches the reference's result exactly, including
+    its equal-weight tie rule (`util.py:41-46`): on a tie, vertex v joins iff
+    v is smaller than its lowest-indexed remaining neighbor of equal weight.
+    """
+    n = wts.shape[-1]
+    remain0 = jnp.ones((n,), bool) if mask is None else mask.astype(bool)
+    idx = jnp.arange(n)
+    adj_b = adj > 0
+
+    def cond(state):
+        remain, _ = state
+        return remain.any()
+
+    def body(state):
+        remain, in_set = state
+        nb = adj_b & remain[None, :]  # nb[v, u]: u is a remaining neighbor of v
+        has_nb = nb.any(axis=1)
+        w_nb = jnp.where(nb, wts[None, :], -jnp.inf)
+        nb_max = w_nb.max(axis=1)
+        tied = nb & (wts[None, :] == nb_max[:, None])
+        first_tied = jnp.argmax(tied, axis=1)  # lowest index achieving the max
+        join = (~has_nb) | (wts > nb_max) | ((wts == nb_max) & (idx < first_tied))
+        new = remain & join
+        eliminated = (adj_b & new[None, :]).any(axis=1)
+        return remain & ~new & ~eliminated, in_set | new
+
+    _, in_set = lax.while_loop(cond, body, (remain0, jnp.zeros((n,), bool)))
+    return in_set, jnp.sum(jnp.where(in_set, wts, 0.0))

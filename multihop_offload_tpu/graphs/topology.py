@@ -66,7 +66,8 @@ def _line_graph_adjacency(link_ends: np.ndarray, n: int) -> np.ndarray:
     reference `offloading_v3.py:65`).  Vectorized via the node-link incidence
     matrix: A_lg = B @ B.T with shared-endpoint count, minus self-loops."""
     num_links = link_ends.shape[0]
-    inc = np.zeros((num_links, n), dtype=np.int32)
+    # float32 so the product runs through BLAS; entries are 0/1/2, exact
+    inc = np.zeros((num_links, n), dtype=np.float32)
     rows = np.arange(num_links)
     inc[rows, link_ends[:, 0]] = 1
     inc[rows, link_ends[:, 1]] = 1

@@ -181,11 +181,20 @@ def _rows(rec, counts, metrics_per_method, runtime, fid, ni_offset=0,
     return rows
 
 
+@jax.jit
+def _metrics_batch(totals, baseline_totals, masks, t_max):
+    return jax.vmap(partial(instance_metrics, t_max=t_max))(
+        totals, baseline_totals, masks
+    )
+
+
 def _method_metrics(totals_by_method, baseline_totals, masks, t_max):
+    """One jitted call + one bulk device->host fetch per method (an eager
+    vmap here costs dozens of per-op round trips on a tunneled TPU)."""
     out = {}
     for name, totals in totals_by_method.items():
-        m = jax.vmap(lambda t, b, mk: instance_metrics(t, b, mk, t_max))(
-            totals, baseline_totals, masks
+        m = jax.device_get(
+            _metrics_batch(totals, baseline_totals, masks, jnp.asarray(t_max))
         )
         out[name] = (
             np.asarray(m.tau), np.asarray(m.congest_jobs),

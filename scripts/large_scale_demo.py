@@ -1,0 +1,161 @@
+"""Beyond-paper-scale demo — BASELINE.json config 5.
+
+The reference tops out at 110-node networks (its line graphs a few hundred
+links, `SURVEY.md` §0).  This driver runs the full GNN offloading pipeline —
+spectral ChebConv forward, predicted-delay APSP, greedy offloading, empirical
+queueing evaluation, and the actor/critic backward — on a ~1000-node
+Erdős–Rényi / Poisson-disk network on one TPU chip, with the Pallas min-plus
+APSP kernel carrying the O(N^3) shortest-path work.
+
+Usage:  python scripts/large_scale_demo.py [--n 1000] [--gtype er]
+        [--apsp pallas|xla] [--k 3] [--steps 5]
+Prints one JSON line with build/compile/step timings and policy metrics.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_case(n: int, gtype: str, seed: int, rng: np.random.Generator):
+    """A large network with randomized roles/capacities (the dataset
+    generator's min-cut heuristics are impractical at this scale; roles are
+    sampled with the same marginal distributions,
+    `data_generation_offloading.py:78-133`)."""
+    from multihop_offload_tpu.graphs import generators
+    from multihop_offload_tpu.graphs.topology import build_topology, sample_link_rates
+
+    if gtype == "poisson":
+        adj, pos, _ = generators.connected_poisson_disk(n, seed=seed)
+        topo = build_topology(adj, pos)
+    else:
+        for attempt in range(100):
+            adj, pos = generators.generate(gtype, n, seed + attempt)
+            topo = build_topology(adj, pos)
+            if topo.connected:
+                break
+        else:
+            raise RuntimeError("no connected topology found")
+
+    roles = np.zeros(n, dtype=np.int32)
+    num_servers = max(1, int(0.10 * n))
+    num_relays = max(1, int(0.02 * n))
+    perm = rng.permutation(n)
+    roles[perm[:num_servers]] = 1
+    roles[perm[num_servers:num_servers + num_relays]] = 2
+    proc_bws = rng.pareto(2.0, n) * 8.0 + 1.0
+    proc_bws[roles == 1] = rng.pareto(2.0, num_servers) * 100.0 + 10.0
+    proc_bws[roles == 2] = 0.0
+    link_rates = sample_link_rates(topo, rng.uniform(30.0, 70.0, topo.num_links),
+                                   rng=rng)
+    return topo, roles, proc_bws, link_rates
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=1000)
+    ap.add_argument("--gtype", default="er", choices=["er", "ba", "ws", "poisson"])
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--load", type=float, default=0.15)
+    ap.add_argument("--T", type=float, default=1000.0)
+    ap.add_argument("--k", type=int, default=3, help="Chebyshev order")
+    ap.add_argument("--apsp", default="pallas", choices=["pallas", "xla"])
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--backward", action="store_true",
+                    help="also time the actor/critic training step")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from multihop_offload_tpu.agent import forward_backward, forward_env
+    from multihop_offload_tpu.config import Config
+    from multihop_offload_tpu.graphs.instance import (
+        PadSpec, build_instance, build_jobset,
+    )
+    from multihop_offload_tpu.models import make_model
+    from multihop_offload_tpu.models.chebconv import chebyshev_support
+    from multihop_offload_tpu.ops.minplus import apsp_minplus_pallas
+
+    rng = np.random.default_rng(args.seed)
+    t0 = time.time()
+    topo, roles, proc_bws, link_rates = build_case(args.n, args.gtype, args.seed, rng)
+    pad = PadSpec(
+        n=PadSpec.round_up(topo.n, 8), l=PadSpec.round_up(topo.num_links, 8),
+        s=PadSpec.round_up(int((roles == 1).sum()), 8),
+        j=PadSpec.round_up(int((roles == 0).sum()), 8),
+    )
+    inst = build_instance(topo, roles, proc_bws, link_rates, args.T, pad)
+    mobile = np.flatnonzero(roles == 0)
+    nj = int(0.5 * mobile.size)
+    jobs = build_jobset(rng.permutation(mobile)[:nj],
+                        args.load * rng.uniform(0.1, 0.5, nj), pad_jobs=pad.j)
+    t_build = time.time() - t0
+
+    cfg = Config(cheb_k=args.k, T=int(args.T))
+    model = make_model(cfg)
+    support = inst.adj_ext if args.k == 1 else chebyshev_support(
+        inst.adj_ext, inst.ext_mask
+    )
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((pad.e, 4)), support)
+    apsp_fn = apsp_minplus_pallas if args.apsp == "pallas" else None
+
+    @jax.jit
+    def eval_step(variables, key):
+        outcome, _ = forward_env(model, variables, inst, jobs, key,
+                                 support=support, apsp_fn=apsp_fn)
+        return outcome.delays.job_total, outcome.decision.dst
+
+    key = jax.random.PRNGKey(1)
+    t0 = time.time()
+    totals, decisions = jax.block_until_ready(eval_step(variables, key))
+    t_compile = time.time() - t0
+    t0 = time.time()
+    for i in range(args.steps):
+        totals, decisions = eval_step(variables, jax.random.fold_in(key, i))
+    jax.block_until_ready(totals)
+    t_step = (time.time() - t0) / args.steps
+
+    report = {
+        "metric": "large_scale_forward_env",
+        "n": topo.n, "links": topo.num_links, "ext_slots": int(pad.e),
+        "jobs": nj, "gtype": args.gtype, "cheb_k": args.k, "apsp": args.apsp,
+        "build_s": round(t_build, 3), "compile_s": round(t_compile, 2),
+        "step_s": round(t_step, 4),
+        "tau": round(float(np.asarray(totals)[:nj].mean()), 3),
+        "congested_ratio": round(float((np.asarray(totals)[:nj] > args.T).mean()), 4),
+        "offloaded_ratio": round(
+            float((np.asarray(decisions)[:nj] != np.asarray(jobs.src)[:nj]).mean()), 4
+        ),
+    }
+
+    if args.backward:
+        @jax.jit
+        def train_step(variables, key):
+            return forward_backward(model, variables, inst, jobs, key,
+                                    support=support, apsp_fn=apsp_fn)
+
+        t0 = time.time()
+        outs = jax.block_until_ready(train_step(variables, key))
+        report["bwd_compile_s"] = round(time.time() - t0, 2)
+        t0 = time.time()
+        for i in range(args.steps):
+            outs = train_step(variables, jax.random.fold_in(key, i))
+        jax.block_until_ready(outs.loss_critic)
+        report["bwd_step_s"] = round((time.time() - t0) / args.steps, 4)
+        report["loss_critic"] = round(float(outs.loss_critic), 2)
+
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

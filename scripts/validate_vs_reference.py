@@ -1,0 +1,103 @@
+"""End-to-end validation against the reference's shipped result CSVs.
+
+Runs our Evaluator (`bash/test.sh` -> `AdHoc_test.py` workflow) over the
+reference's real test set (`data/aco_data_ba_100`) with the reference's own
+shipped checkpoint (`model_ChebConv_BAT800_a5_c5_ACO_agent`, imported via
+`models.tf_import`), then compares per-method aggregates with the reference's
+published run (`out/Adhoc_test_data_aco_data_ba_100_load_0.15_T_1000.csv`,
+schema `AdHoc_test.py:160-176`).
+
+Workloads are random (the reference's are unseeded, SURVEY.md S4), so the
+comparison is distributional: mean per-task latency tau, congested-task ratio,
+and latency-ratio-vs-baseline per method, over the same network files.
+
+Usage:  python scripts/validate_vs_reference.py [--files N] [--dtype float64]
+Writes: out/validation_vs_reference.json (+ the Evaluator's CSV under out/).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+import pandas as pd
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REF = "/root/reference"
+REF_DATA = os.path.join(REF, "data", "aco_data_ba_100")
+REF_MODEL_ROOT = os.path.join(REF, "model")
+REF_CSV = os.path.join(
+    REF, "out", "Adhoc_test_data_aco_data_ba_100_load_0.15_T_1000.csv"
+)
+ALGO_MAP = {"baseline": "baseline", "local": "local", "GNN": "GNN"}
+
+
+def aggregates(df: pd.DataFrame, algo_col: str) -> dict:
+    out = {}
+    for algo, g in df.groupby(algo_col):
+        out[str(algo)] = {
+            "mean_tau": float(g["tau"].mean()),
+            "congested_ratio": float(g["congest_jobs"].sum() / g["num_jobs"].sum()),
+            "mean_ratio_vs_baseline": float(
+                g["gnn_bl_ratio"].replace([np.inf, -np.inf], np.nan).mean()
+            ),
+            "rows": int(len(g)),
+        }
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--files", type=int, default=None, help="limit network files")
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--out", default="out")
+    args = ap.parse_args()
+
+    from multihop_offload_tpu.config import Config
+    from multihop_offload_tpu.train.driver import Evaluator
+
+    cfg = Config(
+        datapath=REF_DATA,
+        out=args.out,
+        T=1000,
+        arrival_scale=0.15,
+        training_set="BAT800",
+        model_root=REF_MODEL_ROOT,
+        dtype=args.dtype,
+        seed=7,
+    )
+    ev = Evaluator(cfg)
+    csv_path = ev.run(files_limit=args.files, verbose=True)
+
+    ours = pd.read_csv(csv_path)
+    ref = pd.read_csv(REF_CSV)
+    # compare on the same network files only
+    ref = ref[ref["filename"].isin(set(ours["filename"]))]
+
+    ours_agg = aggregates(ours, "Algo")
+    ref_agg = aggregates(ref, "Algo")
+
+    report = {"ours_csv": csv_path, "reference_csv": REF_CSV, "methods": {}}
+    print(f"\n{'method':<10} {'metric':<24} {'reference':>12} {'ours':>12} {'rel diff':>9}")
+    for algo in ALGO_MAP:
+        r, o = ref_agg.get(algo, {}), ours_agg.get(algo, {})
+        report["methods"][algo] = {"reference": r, "ours": o}
+        for metric in ("mean_tau", "congested_ratio", "mean_ratio_vs_baseline"):
+            rv, ov = r.get(metric, float("nan")), o.get(metric, float("nan"))
+            rel = (ov - rv) / rv if rv else float("nan")
+            print(f"{algo:<10} {metric:<24} {rv:>12.4f} {ov:>12.4f} {rel:>+8.1%}")
+
+    path = os.path.join(args.out, "validation_vs_reference.json")
+    os.makedirs(args.out, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(report, f, indent=2)
+    print(f"\nwrote {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

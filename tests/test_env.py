@@ -223,3 +223,65 @@ def test_vmap_batch_consistency(small_cases):
             np.asarray(single.delays.job_total),
             rtol=1e-12,
         )
+
+
+def test_local_greedy_mwis_matches_reference_algorithm():
+    """Set-for-set equality with a direct NumPy port of the reference's
+    `local_greedy_search` (`util.py:12-51`), ties included."""
+    import jax.numpy as jnp
+
+    from multihop_offload_tpu.env import local_greedy_mwis
+
+    def oracle(adj, wts):
+        wts = np.asarray(wts, dtype=float)
+        mwis, remain, nb_is = set(), set(range(wts.size)), set()
+        while remain:
+            for v in sorted(remain):
+                nb_set = set(np.flatnonzero(adj[v])) & remain
+                if not nb_set:
+                    mwis.add(v)
+                    continue
+                nb_list = sorted(nb_set)
+                wts_nb = wts[nb_list]
+                w_bar = wts_nb.max()
+                if wts[v] > w_bar:
+                    mwis.add(v)
+                    nb_is |= nb_set
+                elif wts[v] == w_bar:
+                    nbv = nb_list[list(wts_nb).index(wts[v])]
+                    if v < nbv:
+                        mwis.add(v)
+                        nb_is |= nb_set
+            remain = remain - mwis - nb_is
+        return mwis, wts[sorted(mwis)].sum()
+
+    rng = np.random.default_rng(11)
+    for trial in range(8):
+        n = int(rng.integers(5, 40))
+        adj = (rng.uniform(size=(n, n)) < 0.2).astype(np.float64)
+        adj = np.triu(adj, 1)
+        adj = adj + adj.T
+        # integer weights force ties through the tie-break branch
+        wts = rng.integers(1, 6, n).astype(np.float64)
+        exp_set, exp_total = oracle(adj, wts)
+        got_mask, got_total = local_greedy_mwis(jnp.asarray(adj), jnp.asarray(wts))
+        got_set = set(np.flatnonzero(np.asarray(got_mask)))
+        assert got_set == exp_set, (trial, got_set, exp_set)
+        assert float(got_total) == exp_total
+        # independence
+        assert not any(adj[u, v] for u in got_set for v in got_set if u != v)
+
+
+def test_local_greedy_mwis_respects_mask():
+    import jax.numpy as jnp
+
+    from multihop_offload_tpu.env import local_greedy_mwis
+
+    adj = np.zeros((4, 4))
+    adj[0, 1] = adj[1, 0] = 1.0
+    wts = np.array([5.0, 9.0, 3.0, 7.0])
+    mask = np.array([True, True, True, False])
+    got, total = local_greedy_mwis(jnp.asarray(adj), jnp.asarray(wts),
+                                   jnp.asarray(mask))
+    assert set(np.flatnonzero(np.asarray(got))) == {1, 2}
+    assert float(total) == 12.0

@@ -40,3 +40,52 @@ def test_pallas_apsp_batched():
         expect = np.asarray(apsp_minplus(jnp.asarray(ws[b], jnp.float32)))
         finite = np.isfinite(expect)
         np.testing.assert_allclose(got[b][finite], expect[finite], rtol=1e-6)
+
+
+def test_forward_env_accepts_pallas_apsp():
+    """The large-scale path (scripts/large_scale_demo.py) swaps the APSP
+    kernel via `apsp_fn`; decisions and delays must be invariant to it."""
+    import functools
+
+    import jax
+
+    from multihop_offload_tpu.agent import forward_env
+    from multihop_offload_tpu.config import Config
+    from multihop_offload_tpu.graphs import generators
+    from multihop_offload_tpu.graphs.instance import (
+        PadSpec, build_instance, build_jobset,
+    )
+    from multihop_offload_tpu.graphs.topology import build_topology, sample_link_rates
+    from multihop_offload_tpu.models import make_model
+
+    rng = np.random.default_rng(3)
+    adj, _ = generators.generate("er", 24, seed=5)
+    topo = build_topology(adj)
+    roles = np.zeros(24, dtype=np.int32)
+    roles[[3, 11]] = 1
+    bws = np.where(roles == 1, 80.0, 4.0)
+    rates = sample_link_rates(topo, 50.0, rng=rng)
+    pad = PadSpec(n=24, l=PadSpec.round_up(topo.num_links, 8), s=8, j=8)
+    inst = build_instance(topo, roles, bws, rates, 1000.0, pad, dtype=np.float64)
+    mobile = np.flatnonzero(roles == 0)
+    jobs = build_jobset(mobile[:6], 0.15 * rng.uniform(0.1, 0.5, 6), pad_jobs=8,
+                        dtype=np.float64)
+
+    cfg = Config(dtype="float64")
+    model = make_model(cfg)
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((pad.e, 4), jnp.float64), inst.adj_ext
+    )
+    key = jax.random.PRNGKey(9)
+    out_xla, _ = forward_env(model, variables, inst, jobs, key)
+    out_pl, _ = forward_env(
+        model, variables, inst, jobs, key,
+        apsp_fn=functools.partial(apsp_minplus_pallas, interpret=True),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out_xla.decision.dst), np.asarray(out_pl.decision.dst)
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_xla.job_total), np.asarray(out_pl.job_total),
+        rtol=1e-9, equal_nan=True,
+    )
