@@ -65,6 +65,9 @@ class Config:
     mesh_data: int = 1             # data-parallel mesh axis size
     mesh_graph: int = 1            # graph-partition (ring APSP) axis size
     model_root: str = "model"      # parent dir of checkpoint directories
+    tb_logdir: str = ""            # TensorBoard scalars ("" = disabled); the
+    #                                working version of the reference's
+    #                                disabled log_init/log_scalar hooks
 
     @property
     def jnp_dtype(self):

@@ -16,7 +16,7 @@ gather/segment-sum forms would be slower on TPU at this size.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -36,19 +36,25 @@ class ChebConv(nn.Module):
     k: int = 1
     use_bias: bool = True
     param_dtype: jnp.dtype = jnp.float32
+    # graph-propagation op (support, activations) -> activations; the default
+    # is the dense on-chip matmul.  `parallel.partition` swaps in a
+    # halo-exchange matmul to row-shard the graph across a mesh axis while
+    # reusing the exact same parameters.
+    propagate: Optional[Callable] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, support: jnp.ndarray) -> jnp.ndarray:
         kernel = self.param(
             "kernel", _glorot, (self.k, x.shape[-1], self.channels), self.param_dtype
         )
+        prop = self.propagate if self.propagate is not None else jnp.matmul
         t_prev2 = x
         out = t_prev2 @ kernel[0]
         if self.k > 1:
-            t_prev = support @ x
+            t_prev = prop(support, x)
             out = out + t_prev @ kernel[1]
             for i in range(2, self.k):
-                t_cur = 2.0 * (support @ t_prev) - t_prev2
+                t_cur = 2.0 * prop(support, t_prev) - t_prev2
                 out = out + t_cur @ kernel[i]
                 t_prev2, t_prev = t_prev, t_cur
         if self.use_bias:
@@ -70,6 +76,7 @@ class ChebNet(nn.Module):
     dropout: float = 0.0
     leaky_alpha: float = 0.2
     param_dtype: jnp.dtype = jnp.float32
+    propagate: Optional[Callable] = None
 
     @nn.compact
     def __call__(
@@ -85,6 +92,7 @@ class ChebNet(nn.Module):
                 channels=self.out_dim if last else self.hidden,
                 k=self.k,
                 param_dtype=self.param_dtype,
+                propagate=self.propagate,
                 name=f"cheb_{layer}",
             )(x, support)
             x = nn.relu(x) if last else nn.leaky_relu(x, self.leaky_alpha)

@@ -2,3 +2,4 @@ from multihop_offload_tpu.ops.minplus import (  # noqa: F401
     apsp_minplus_pallas,
     minplus_power_kernel_call,
 )
+from multihop_offload_tpu.ops.fixed_point import fixed_point_pallas  # noqa: F401

@@ -1,0 +1,130 @@
+"""Pallas TPU kernel for the conflict-interference fixed point.
+
+The queueing model's inner loop (`offloading_v3.py:500-506`, reimplemented in
+`env.queueing.interference_fixed_point`) iterates 10 rounds of
+
+    busy = clip(lambda / mu, 0, 1);  mu = rate / (1 + A_conflict @ busy)
+
+XLA re-reads the (L, L) conflict adjacency from HBM every round.  This kernel
+pins the adjacency block and all per-link vectors in VMEM for the whole
+fixed point: one HBM read of A total, ten on-chip matvecs (the adjacency is
+symmetric, so `A @ busy` is the row-vector product `busy @ A` — MXU work with
+no transposes).
+
+Differentiability: the actor and critic reverse-differentiate through the
+unrolled iterations (`gnn_offloading_agent.py:240-244,348-352`).  Pallas
+kernels carry no AD rules, so `fixed_point_pallas` wears a `custom_vjp`
+whose backward recomputes the scan in XLA and pulls back through it —
+forward stays in VMEM, gradients stay exact.
+
+Grid = batch; one program per (L, L) conflict matrix, L padded to the
+128-lane width (padding: rate 1, cf_deg 0, lambda 0, zero adjacency rows —
+inert: busy=0, mu=1).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+_LANE = 128
+
+
+def _fp_kernel(adj_ref, rates_ref, cf_ref, lam_ref, mu_ref, *, iters: int):
+    adj = adj_ref[0]          # (L, L)
+    rates = rates_ref[0]      # (1, L)
+    cf = cf_ref[0]
+    lam = lam_ref[0]
+    mu0 = rates / (cf + 1.0)
+
+    def body(_, mu):
+        busy = jnp.clip(lam / mu, 0.0, 1.0)
+        neighbor = jnp.dot(busy, adj)       # == adj @ busy (A symmetric)
+        return rates / (1.0 + neighbor)
+
+    mu_ref[0] = lax.fori_loop(0, iters, body, mu0)
+
+
+def _pallas_call(adj, rates, cf, lam, iters: int, interpret: bool):
+    b, l, _ = adj.shape
+    kernel = functools.partial(_fp_kernel, iters=iters)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, 1, l), adj.dtype),
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, l, l), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1, l), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1, l), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1, l), lambda i: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, l), lambda i: (i, 0, 0)),
+        interpret=interpret,
+    )(adj, rates, cf, lam)
+
+
+def _xla_reference(adj, rates, cf, lam, num_iters):
+    mu0 = rates / (cf + 1.0)
+
+    def body(mu, _):
+        busy = jnp.clip(lam / mu, 0.0, 1.0)
+        # einsum so the backward pass handles batched (B, L, L) x (B, L) too
+        neighbor = jnp.einsum("...ij,...j->...i", adj, busy)
+        return rates / (1.0 + neighbor), None
+
+    mu, _ = lax.scan(body, mu0, None, length=num_iters)
+    return mu
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def fixed_point_pallas(
+    adj_conflict: jnp.ndarray,
+    link_rates: jnp.ndarray,
+    cf_degs: jnp.ndarray,
+    link_lambda: jnp.ndarray,
+    num_iters: int = 10,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Drop-in `interference_fixed_point` core: (L, L), (L,), (L,), (L,) ->
+    converged mu (L,).  Also accepts a leading batch axis on every operand."""
+    squeeze = adj_conflict.ndim == 2
+    adj = adj_conflict[None] if squeeze else adj_conflict
+    vecs = [x[None] if squeeze else x for x in (link_rates, cf_degs, link_lambda)]
+    b, l, _ = adj.shape
+    l_pad = max(_LANE, math.ceil(l / _LANE) * _LANE)
+    if l_pad != l:
+        adj = jnp.pad(adj, ((0, 0), (0, l_pad - l), (0, l_pad - l)))
+        rates = jnp.pad(vecs[0], ((0, 0), (0, l_pad - l)), constant_values=1.0)
+        cf = jnp.pad(vecs[1], ((0, 0), (0, l_pad - l)))
+        lam = jnp.pad(vecs[2], ((0, 0), (0, l_pad - l)))
+    else:
+        rates, cf, lam = vecs
+    mu = _pallas_call(
+        adj, rates[:, None, :], cf[:, None, :], lam[:, None, :],
+        num_iters, interpret,
+    )[:, 0, :l]
+    return mu[0] if squeeze else mu
+
+
+def _fp_fwd(adj, rates, cf, lam, num_iters, interpret):
+    mu = fixed_point_pallas(adj, rates, cf, lam, num_iters, interpret)
+    return mu, (adj, rates, cf, lam)
+
+
+def _fp_bwd(num_iters, interpret, res, g):
+    adj, rates, cf, lam = res
+    # recompute-and-pull-back through the XLA scan: exact, and the forward
+    # already paid only one HBM pass
+    _, vjp = jax.vjp(
+        functools.partial(_xla_reference, num_iters=num_iters),
+        adj, rates, cf, lam,
+    )
+    return vjp(g)
+
+
+fixed_point_pallas.defvjp(_fp_fwd, _fp_bwd)

@@ -8,3 +8,9 @@ from multihop_offload_tpu.parallel.data_parallel import (  # noqa: F401
     make_dp_eval_step,
     make_multichip_train_step,
 )
+from multihop_offload_tpu.parallel.partition import (  # noqa: F401
+    halo_matmul,
+    sharded_chebnet_apply,
+    sharded_interference_fixed_point,
+    sharded_spectral_forward,
+)

@@ -1,0 +1,104 @@
+"""Graph-partition parallelism with halo exchange over a mesh axis.
+
+For a single network too large for one chip, the graph's vertex sets (links
+of the conflict graph, slots of the extended line graph) are row-sharded
+across the `graph` mesh axis.  Each propagation step — a conflict-coupling
+matvec in the queueing fixed point, or a Chebyshev-recursion matmul in the
+GNN — computes the resident row block against the full activation vector,
+which is reassembled each step by `all_gather`: the halo exchange.  This is
+the sparse-propagation analogue of sequence parallelism (SURVEY.md §5.7 —
+"the ring attention equivalent"): activations stream over ICI while every
+chip's MXU works only on its resident block; the O(L^2) adjacency never
+moves.  Complements `parallel.ring` (row-sharded min-plus APSP via
+`lax.ppermute`).
+
+All functions run inside `shard_map` with `axis_name` bound and expect
+row counts divisible by the axis size.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def halo_matmul(axis_name: str) -> Callable:
+    """(rows, L) x (L_local, ...) propagation op: gather the sharded
+    activations into the full vector, multiply the resident block."""
+
+    def prop(support_rows: jnp.ndarray, x_rows: jnp.ndarray) -> jnp.ndarray:
+        x_full = lax.all_gather(x_rows, axis_name, axis=0, tiled=True)
+        return support_rows @ x_full
+
+    return prop
+
+
+def sharded_interference_fixed_point(
+    adj_conflict_rows: jnp.ndarray,   # (L_local, L) conflict adjacency block
+    link_rates_rows: jnp.ndarray,     # (L_local,)
+    cf_degs_rows: jnp.ndarray,        # (L_local,)
+    link_lambda_rows: jnp.ndarray,    # (L_local,)
+    axis_name: str,
+    num_iters: int = 10,
+) -> jnp.ndarray:
+    """Row-sharded `env.queueing.interference_fixed_point`
+    (`offloading_v3.py:500-506`): mu_0 = rate/(cf_deg+1); iterate
+    busy = clip(lambda/mu, 0, 1); mu = rate/(1 + A_conflict @ busy).
+    Per iteration, one tiled all_gather of the (L,) busy vector — the halo —
+    and one local (L_local, L) matvec.  Returns this device's mu rows.
+    """
+    mu0 = link_rates_rows / (cf_degs_rows + 1.0)
+
+    def body(mu_rows, _):
+        busy_rows = jnp.clip(link_lambda_rows / mu_rows, 0.0, 1.0)
+        busy_full = lax.all_gather(busy_rows, axis_name, axis=0, tiled=True)
+        neighbor_busy = adj_conflict_rows @ busy_full
+        return link_rates_rows / (1.0 + neighbor_busy), None
+
+    mu_rows, _ = lax.scan(body, mu0, None, length=num_iters)
+    return mu_rows
+
+
+def sharded_chebnet_apply(
+    model,
+    variables,
+    x_rows: jnp.ndarray,        # (E_local, F) feature block
+    support_rows: jnp.ndarray,  # (E_local, E) support block
+    axis_name: str,
+) -> jnp.ndarray:
+    """Apply a `models.ChebNet` with the graph row-sharded: identical
+    parameters, identical math, but every Chebyshev propagation is a halo
+    matmul.  Pointwise pieces (kernel contraction, bias, activations) stay
+    local to the rows.  Returns this device's output rows.
+    """
+    sharded = model.clone(propagate=halo_matmul(axis_name))
+    return sharded.apply(variables, x_rows, support_rows)
+
+
+def sharded_spectral_forward(
+    model,
+    variables,
+    feats: jnp.ndarray,      # (E, F) replicated along `axis_name`
+    support: jnp.ndarray,    # (E, E) replicated along `axis_name`
+    axis_name: str,
+) -> jnp.ndarray:
+    """Full-in/full-out convenience wrapper (inside `shard_map` with the
+    inputs replicated on `axis_name`): slice this device's rows, run the
+    sharded forward, regather the output."""
+    e = feats.shape[0]
+    n_dev = lax.axis_size(axis_name)
+    if e % n_dev:
+        raise ValueError(
+            f"graph size {e} not divisible by axis '{axis_name}' ({n_dev} "
+            f"devices); pad the extended graph (PadSpec round_to) to a multiple"
+        )
+    idx = lax.axis_index(axis_name)
+    rows = e // n_dev
+    start = (idx * rows).astype(jnp.int32)
+    x_rows = lax.dynamic_slice_in_dim(feats, start, rows, axis=0)
+    s_rows = lax.dynamic_slice_in_dim(support, start, rows, axis=0)
+    out_rows = sharded_chebnet_apply(model, variables, x_rows, s_rows, axis_name)
+    return lax.all_gather(out_rows, axis_name, axis=0, tiled=True)

@@ -39,6 +39,7 @@ from multihop_offload_tpu.models import load_reference_checkpoint, make_model
 from multihop_offload_tpu.train import checkpoints as ckpt_lib
 from multihop_offload_tpu.train.data import DatasetCache, sample_jobsets
 from multihop_offload_tpu.train.metrics import instance_metrics
+from multihop_offload_tpu.train.tb_logging import ScalarLogger
 
 TRAIN_COLUMNS = [
     "fid", "filename", "seed", "num_nodes", "m", "num_mobile", "num_servers",
@@ -220,6 +221,7 @@ class Trainer(_Harness):
         explore = cfg.explore
         losses = []
         gidx = 0
+        tb = ScalarLogger(cfg.tb_logdir)
         for epoch in range(epochs if epochs is not None else cfg.epochs):
             order = self.rng.permutation(len(self.data))
             if files_limit:
@@ -271,6 +273,10 @@ class Trainer(_Harness):
                     if verbose:
                         print(f"{gidx} Loss: {np.nanmean(losses):.2f}, "
                               f"explore: {explore:.4f}")
+                    if tb.active:
+                        tb.log_scalar("replay_loss", loss, gidx)
+                        tb.log_scalar("explore", explore, gidx)
+                        tb.log_scalar("mse_loss", float(jnp.nanmean(loss_m)), gidx)
                     losses = []
                 gidx += 1
                 pd.DataFrame(rows, columns=TRAIN_COLUMNS).to_csv(csv_path, index=False)

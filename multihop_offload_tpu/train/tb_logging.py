@@ -1,0 +1,47 @@
+"""TensorBoard scalar logging — the working version of the reference's
+disabled hooks.
+
+The reference ships `log_init`/`log_scalar` (`gnn_offloading_agent.py:
+455-468`) but every call site is commented out (`AdHoc_train.py:74,211-213`).
+Here the equivalent is live: event files written via TF's summary writer
+(TF is already a dependency of the checkpoint importer), viewable alongside
+`utils.profiling.trace` device profiles in one TensorBoard.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class ScalarLogger:
+    """`log_scalar(tag, value, step)` onto a TensorBoard event file.
+
+    Falls back to a no-op when TensorFlow is unavailable so training never
+    depends on it.
+    """
+
+    def __init__(self, logdir: Optional[str]):
+        self._writer = None
+        if not logdir:
+            return
+        try:
+            import tensorflow as tf  # noqa: PLC0415
+
+            self._writer = tf.summary.create_file_writer(logdir)
+            self._tf = tf
+        except Exception:  # pragma: no cover - TF missing
+            self._writer = None
+
+    @property
+    def active(self) -> bool:
+        return self._writer is not None
+
+    def log_scalar(self, tag: str, value, step: int) -> None:
+        if self._writer is None:
+            return
+        with self._writer.as_default():
+            self._tf.summary.scalar(tag, float(value), step=step)
+
+    def flush(self) -> None:
+        if self._writer is not None:
+            self._writer.flush()

@@ -12,7 +12,9 @@ comparison is distributional: mean per-task latency tau, congested-task ratio,
 and latency-ratio-vs-baseline per method, over the same network files.
 
 Usage:  python scripts/validate_vs_reference.py [--files N] [--dtype float64]
-Writes: out/validation_vs_reference.json (+ the Evaluator's CSV under out/).
+        [--scale 0.15|0.20]
+Writes: out/validation_vs_reference_load_{scale:.2f}.json (+ the Evaluator's
+CSV under out/).
 """
 
 from __future__ import annotations
@@ -30,9 +32,6 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 REF = "/root/reference"
 REF_DATA = os.path.join(REF, "data", "aco_data_ba_100")
 REF_MODEL_ROOT = os.path.join(REF, "model")
-REF_CSV = os.path.join(
-    REF, "out", "Adhoc_test_data_aco_data_ba_100_load_0.15_T_1000.csv"
-)
 ALGO_MAP = {"baseline": "baseline", "local": "local", "GNN": "GNN"}
 
 
@@ -55,7 +54,14 @@ def main() -> int:
     ap.add_argument("--files", type=int, default=None, help="limit network files")
     ap.add_argument("--dtype", default="float32")
     ap.add_argument("--out", default="out")
+    ap.add_argument("--scale", type=float, default=0.15,
+                    help="arrival load scale; the reference shipped runs at "
+                         "0.15 and 0.20")
     args = ap.parse_args()
+    ref_csv = os.path.join(
+        REF, "out",
+        f"Adhoc_test_data_aco_data_ba_100_load_{args.scale:.2f}_T_1000.csv",
+    )
 
     from multihop_offload_tpu.config import Config
     from multihop_offload_tpu.train.driver import Evaluator
@@ -64,7 +70,7 @@ def main() -> int:
         datapath=REF_DATA,
         out=args.out,
         T=1000,
-        arrival_scale=0.15,
+        arrival_scale=args.scale,
         training_set="BAT800",
         model_root=REF_MODEL_ROOT,
         dtype=args.dtype,
@@ -74,14 +80,14 @@ def main() -> int:
     csv_path = ev.run(files_limit=args.files, verbose=True)
 
     ours = pd.read_csv(csv_path)
-    ref = pd.read_csv(REF_CSV)
+    ref = pd.read_csv(ref_csv)
     # compare on the same network files only
     ref = ref[ref["filename"].isin(set(ours["filename"]))]
 
     ours_agg = aggregates(ours, "Algo")
     ref_agg = aggregates(ref, "Algo")
 
-    report = {"ours_csv": csv_path, "reference_csv": REF_CSV, "methods": {}}
+    report = {"ours_csv": csv_path, "reference_csv": ref_csv, "methods": {}}
     print(f"\n{'method':<10} {'metric':<24} {'reference':>12} {'ours':>12} {'rel diff':>9}")
     for algo in ALGO_MAP:
         r, o = ref_agg.get(algo, {}), ours_agg.get(algo, {})
@@ -91,7 +97,7 @@ def main() -> int:
             rel = (ov - rv) / rv if rv else float("nan")
             print(f"{algo:<10} {metric:<24} {rv:>12.4f} {ov:>12.4f} {rel:>+8.1%}")
 
-    path = os.path.join(args.out, "validation_vs_reference.json")
+    path = os.path.join(args.out, f"validation_vs_reference_load_{args.scale:.2f}.json")
     os.makedirs(args.out, exist_ok=True)
     with open(path, "w") as f:
         json.dump(report, f, indent=2)

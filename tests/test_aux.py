@@ -108,3 +108,27 @@ def test_phase_timers():
         pass
     s = phase_stats()
     assert s["x"]["count"] == 2 and s["x"]["total_s"] >= 0
+
+
+def test_scalar_logger_writes_event_file(tmp_path):
+    from multihop_offload_tpu.train.tb_logging import ScalarLogger
+
+    lg = ScalarLogger(str(tmp_path / "tb"))
+    if not lg.active:  # TF unavailable in this environment
+        return
+    lg.log_scalar("loss", 1.25, 0)
+    lg.log_scalar("loss", 0.75, 1)
+    lg.flush()
+    import glob
+
+    files = glob.glob(str(tmp_path / "tb" / "events.out.tfevents.*"))
+    assert files and os.path.getsize(files[0]) > 0
+
+
+def test_scalar_logger_disabled_is_noop():
+    from multihop_offload_tpu.train.tb_logging import ScalarLogger
+
+    lg = ScalarLogger("")
+    assert not lg.active
+    lg.log_scalar("x", 1.0, 0)  # must not raise
+    lg.flush()
