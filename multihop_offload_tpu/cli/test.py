@@ -11,6 +11,9 @@ from multihop_offload_tpu.train.driver import Evaluator
 
 
 def main(argv=None):
+    from multihop_offload_tpu.utils.platform import apply_platform_env
+
+    apply_platform_env()
     cfg = from_args(argv)
     csv = Evaluator(cfg).run()
     print(f"test results written to {csv}")

@@ -40,6 +40,31 @@ class EmpiricalDelays:
     unit_mask: jnp.ndarray     # (N, N) bool: entry written by some flow
 
 
+def interference_fixed_point_raw(
+    adj_conflict: jnp.ndarray,
+    link_rates: jnp.ndarray,
+    cf_degs: jnp.ndarray,
+    link_lambda: jnp.ndarray,
+    num_iters: int = 10,
+) -> jnp.ndarray:
+    """Raw-array fixed-point core (batched-aware); THE single definition of
+    the busy/mu update — the Pallas kernel's VJP recompute
+    (`ops.fixed_point`) and the tests pull from here so the math can never
+    drift between copies."""
+    mu0 = link_rates / (cf_degs + 1.0)
+
+    def body(mu, _):
+        busy = jnp.clip(link_lambda / mu, 0.0, 1.0)
+        neighbor_busy = jnp.einsum("...ij,...j->...i", adj_conflict, busy)
+        return link_rates / (1.0 + neighbor_busy), None
+
+    # lax.scan (not fori_loop) so both differentiable critics can reverse-
+    # differentiate through the unrolled iterations, as the reference's
+    # GradientTape does (`gnn_offloading_agent.py:240-244`, `:348-352`).
+    mu, _ = lax.scan(body, mu0, None, length=num_iters)
+    return mu
+
+
 def interference_fixed_point(
     inst: Instance, link_lambda: jnp.ndarray, num_iters: int = 10
 ) -> jnp.ndarray:
@@ -50,18 +75,9 @@ def interference_fixed_point(
     Shared by the empirical evaluator and both differentiable critics
     (`gnn_offloading_agent.py:240-244`, `:348-352`).
     """
-    mu0 = inst.link_rates / (inst.cf_degs + 1.0)
-
-    def body(mu, _):
-        busy = jnp.clip(link_lambda / mu, 0.0, 1.0)
-        neighbor_busy = inst.adj_conflict @ busy
-        return inst.link_rates / (1.0 + neighbor_busy), None
-
-    # lax.scan (not fori_loop) so both differentiable critics can reverse-
-    # differentiate through the unrolled iterations, as the reference's
-    # GradientTape does (`gnn_offloading_agent.py:240-244`, `:348-352`).
-    mu, _ = lax.scan(body, mu0, None, length=num_iters)
-    return mu
+    return interference_fixed_point_raw(
+        inst.adj_conflict, inst.link_rates, inst.cf_degs, link_lambda, num_iters
+    )
 
 
 def run_empirical(

@@ -111,8 +111,32 @@ def generate(gtype: str, n: int, seed: int, m: int = 2):
     return GENERATORS[gtype](n, seed, m=m)
 
 
-def spring_positions(adj: np.ndarray, seed: Optional[int] = None) -> np.ndarray:
-    """Spring layout for plotting (reference `offloading_v3.py:156,163`)."""
+def spring_positions(
+    adj: np.ndarray,
+    seed: Optional[int] = None,
+    cache_dir: Optional[str] = None,
+    name: Optional[str] = None,
+    fresh: bool = False,
+) -> np.ndarray:
+    """Spring layout for plotting (reference `offloading_v3.py:156,163`).
+
+    With `cache_dir` + `name`, layouts are cached on disk (the reference
+    pickles them under `../pos/`, `offloading_v3.py:152-163`; ours are .npy);
+    `fresh=True` recomputes and overwrites (the reference's `pos='new'`).
+    """
+    import os
+
+    path = None
+    if cache_dir and name:
+        path = os.path.join(cache_dir, f"{name}.npy")
+        if not fresh and os.path.isfile(path):
+            cached = np.load(path)
+            if cached.shape == (adj.shape[0], 2):
+                return cached
     g = nx.from_numpy_array(adj)
     pos = nx.spring_layout(g, seed=seed)
-    return np.stack([pos[i] for i in range(adj.shape[0])])
+    out = np.stack([pos[i] for i in range(adj.shape[0])])
+    if path is not None:
+        os.makedirs(cache_dir, exist_ok=True)
+        np.save(path, out)
+    return out

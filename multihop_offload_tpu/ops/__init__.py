@@ -3,3 +3,9 @@ from multihop_offload_tpu.ops.minplus import (  # noqa: F401
     minplus_power_kernel_call,
 )
 from multihop_offload_tpu.ops.fixed_point import fixed_point_pallas  # noqa: F401
+from multihop_offload_tpu.ops.sparse import (  # noqa: F401
+    COO,
+    coo_matmul,
+    coo_propagate,
+    dense_to_coo,
+)

@@ -69,16 +69,11 @@ def _pallas_call(adj, rates, cf, lam, iters: int, interpret: bool):
 
 
 def _xla_reference(adj, rates, cf, lam, num_iters):
-    mu0 = rates / (cf + 1.0)
+    # the one true update lives in env.queueing; the VJP recompute must pull
+    # back through exactly the math the rest of the framework runs
+    from multihop_offload_tpu.env.queueing import interference_fixed_point_raw
 
-    def body(mu, _):
-        busy = jnp.clip(lam / mu, 0.0, 1.0)
-        # einsum so the backward pass handles batched (B, L, L) x (B, L) too
-        neighbor = jnp.einsum("...ij,...j->...i", adj, busy)
-        return rates / (1.0 + neighbor), None
-
-    mu, _ = lax.scan(body, mu0, None, length=num_iters)
-    return mu
+    return interference_fixed_point_raw(adj, rates, cf, lam, num_iters)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))

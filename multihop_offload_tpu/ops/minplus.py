@@ -33,11 +33,16 @@ _LANE = 128
 
 def _apsp_kernel(d_ref, o_ref, *, n: int, iters: int):
     d = d_ref[0]
+    # row index as an iota comparison: Mosaic has no dynamic_slice on a value
+    # held in registers, so row k is extracted with a masked min-reduce
+    # (inert +inf elsewhere) — static ops only, same O(N^2) as the update
+    row_ids = lax.broadcasted_iota(jnp.int32, (n, 1), 0)
 
     def squaring(_, dist):
         def body(k, acc):
-            row = dist[k, :]
-            return jnp.minimum(acc, row[:, None] + row[None, :])
+            masked = jnp.where(row_ids == k, dist, jnp.inf)
+            row = jnp.min(masked, axis=0, keepdims=True)     # (1, N) = dist[k]
+            return jnp.minimum(acc, row.T + row)
 
         return lax.fori_loop(0, n, body, dist)
 
@@ -60,6 +65,14 @@ def minplus_power_kernel_call(
     )(d)
 
 
+_MAX_KERNEL_N = 256  # largest padded size with validated Mosaic compiles;
+#                      above this the per-row fori body makes compile time
+#                      blow up (observed: (1,1024,1024) wedges the compiler
+#                      for >10 min), and the whole-matrix-in-VMEM premise
+#                      stops paying off anyway — fall back to XLA / the
+#                      ring-sharded APSP (`parallel.ring`) instead.
+
+
 def apsp_minplus_pallas(
     weights: jnp.ndarray,
     num_iters: int | None = None,
@@ -68,12 +81,18 @@ def apsp_minplus_pallas(
     """Drop-in replacement for `env.apsp.apsp_minplus` (symmetric weights).
 
     Accepts (N, N) or batched (B, N, N); pads N up to the 128-lane width with
-    +inf (inert) and zero-diagonals the result region.
+    +inf (inert) and zero-diagonals the result region.  Sizes beyond the
+    validated kernel range delegate to the XLA squaring.
     """
     squeeze = weights.ndim == 2
     w = weights[None] if squeeze else weights
     b, n, _ = w.shape
     n_pad = max(_LANE, math.ceil(n / _LANE) * _LANE)
+    if n_pad > _MAX_KERNEL_N and not interpret:
+        from multihop_offload_tpu.env.apsp import apsp_minplus
+
+        out = jax.vmap(lambda m: apsp_minplus(m, num_iters))(w)
+        return out[0] if squeeze else out
     iters = num_iters if num_iters is not None else max(1, math.ceil(math.log2(max(n - 1, 2))))
 
     eye = jnp.eye(n, dtype=bool)

@@ -1,0 +1,60 @@
+"""Sparse (COO) graph propagation via gather + segment-sum.
+
+Dense (E, E) supports are right for the paper-scale graphs (a few hundred
+extended slots -> MXU tiles, `models.chebconv`), but at beyond-paper scale
+(BASELINE.json config 5) the dense support dominates memory and host->device
+transfer: an 8,500-slot extended line graph is a ~290 MB float32 matrix with
+~0.2% nonzeros.  This module provides the fixed-shape sparse alternative:
+edges as padded (row, col, val) COO triples, propagation as
+`segment_sum(vals * x[cols], rows)` — XLA lowers the gather/scatter-add pair
+efficiently on TPU, and every op is static-shape (`nnz` is padded, padding
+rows point at slot 0 with value 0).
+
+`coo_propagate` plugs into `ChebConv.propagate`, so the same Flax parameters
+drive dense, mesh-sharded (`parallel.partition`), or sparse propagation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+
+@struct.dataclass
+class COO:
+    """Padded COO matrix; padding entries have val == 0 and row = col = 0."""
+
+    rows: jnp.ndarray   # (nnz_pad,) int32
+    cols: jnp.ndarray   # (nnz_pad,) int32
+    vals: jnp.ndarray   # (nnz_pad,) float
+    shape: tuple = struct.field(pytree_node=False)  # static logical (n, n)
+
+
+def dense_to_coo(mat: np.ndarray, nnz_pad: int | None = None, round_to: int = 128) -> COO:
+    """Host-side conversion with padding to a static nonzero count."""
+    mat = np.asarray(mat)
+    r, c = np.nonzero(mat)
+    v = mat[r, c]
+    nnz = r.size
+    if nnz_pad is None:
+        nnz_pad = max(round_to, int(-(-nnz // round_to) * round_to))
+    if nnz > nnz_pad:
+        raise ValueError(f"{nnz} nonzeros exceed pad {nnz_pad}")
+    rows = np.zeros(nnz_pad, np.int32)
+    cols = np.zeros(nnz_pad, np.int32)
+    vals = np.zeros(nnz_pad, mat.dtype)
+    rows[:nnz], cols[:nnz], vals[:nnz] = r, c, v
+    return COO(jnp.asarray(rows), jnp.asarray(cols), jnp.asarray(vals), mat.shape)
+
+
+def coo_matmul(coo: COO, x: jnp.ndarray) -> jnp.ndarray:
+    """(n, n) sparse @ (n, F) dense -> (n, F): one gather + one segment-sum."""
+    contrib = coo.vals[:, None] * x[coo.cols]            # (nnz, F)
+    return jax.ops.segment_sum(contrib, coo.rows, num_segments=coo.shape[0])
+
+
+def coo_propagate(support, x: jnp.ndarray) -> jnp.ndarray:
+    """`ChebConv.propagate`-compatible: `support` is a COO pytree."""
+    return coo_matmul(support, x)

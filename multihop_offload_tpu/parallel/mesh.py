@@ -32,3 +32,49 @@ def make_mesh(
         )
     grid = np.asarray(devices[: data * graph]).reshape(data, graph)
     return Mesh(grid, axis_names=("data", "graph"))
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> int:
+    """Multi-host bring-up: join the JAX distributed runtime so
+    `jax.devices()` spans every host and `make_mesh` lays the `data` axis
+    across DCN while `graph` stays on-host ICI.
+
+    The reference has no distributed backend at all (SURVEY.md §5.8) — this
+    is the framework's NCCL/MPI-equivalent entry point, built on JAX's own
+    coordination service.  Explicit args win; otherwise standard cluster env
+    detection (GKE/Slurm/TPU pod metadata) applies; single-process runs
+    no-op.  Returns this process's index.
+    """
+    import os
+
+    if any(a is not None for a in (coordinator_address, num_processes, process_id)):
+        # any explicit arg selects the explicit path; incomplete sets are
+        # jax.distributed's own error to raise, not ours to mask
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        return jax.process_index()
+    cluster_hints = (
+        "COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS",
+        "MEGASCALE_COORDINATOR_ADDRESS", "SLURM_JOB_ID",
+        "OMPI_COMM_WORLD_SIZE", "TPU_WORKER_HOSTNAMES", "CLOUD_TPU_TASK_ID",
+    )
+    if not any(h in os.environ for h in cluster_hints):
+        return 0  # genuinely single-process: no cluster context detected
+    try:
+        jax.distributed.initialize()
+    except ValueError:
+        # jax raises ValueError iff the env hints don't resolve to an actual
+        # cluster spec (e.g. axon hosts export TPU_WORKER_HOSTNAMES with no
+        # coordinator) — that is "no cluster", not a failed bring-up
+        return 0
+    # real bring-up failures (RuntimeError: coordinator unreachable, RPC
+    # errors) propagate — never silently degrade a configured cluster into
+    # n independent single-process runs
+    return jax.process_index()

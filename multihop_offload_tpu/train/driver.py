@@ -280,6 +280,7 @@ class Trainer(_Harness):
                     losses = []
                 gidx += 1
                 pd.DataFrame(rows, columns=TRAIN_COLUMNS).to_csv(csv_path, index=False)
+        tb.flush()
         return csv_path
 
 

@@ -26,11 +26,11 @@ class ScalarLogger:
             return
         try:
             import tensorflow as tf  # noqa: PLC0415
-
-            self._writer = tf.summary.create_file_writer(logdir)
-            self._tf = tf
-        except Exception:  # pragma: no cover - TF missing
-            self._writer = None
+        except ImportError:  # pragma: no cover - TF missing: degrade quietly
+            return
+        # the user asked for logging: a bad logdir must surface, not vanish
+        self._writer = tf.summary.create_file_writer(logdir)
+        self._tf = tf
 
     @property
     def active(self) -> bool:

@@ -1,0 +1,23 @@
+"""Platform selection that survives eager backend registration.
+
+Some hosts pre-import JAX from `sitecustomize` (registering a remote TPU
+backend) before user code — or the `JAX_PLATFORMS` environment variable —
+gets a say.  Entry points call `apply_platform_env()` first thing so
+`JAX_PLATFORMS=cpu python -m multihop_offload_tpu.cli.test ...` behaves as
+documented even on such hosts (`jax.config.update` works after import;
+the env var alone is captured too early).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def apply_platform_env() -> str | None:
+    """Re-apply JAX_PLATFORMS via jax.config; returns the platform applied."""
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms:
+        import jax
+
+        jax.config.update("jax_platforms", platforms)
+    return platforms or None

@@ -24,6 +24,10 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from multihop_offload_tpu.utils.platform import apply_platform_env  # noqa: E402
+
+apply_platform_env()
+
 
 def build_case(n: int, gtype: str, seed: int, rng: np.random.Generator):
     """A large network with randomized roles/capacities (the dataset
@@ -68,6 +72,9 @@ def main() -> int:
     ap.add_argument("--T", type=float, default=1000.0)
     ap.add_argument("--k", type=int, default=3, help="Chebyshev order")
     ap.add_argument("--apsp", default="pallas", choices=["pallas", "xla"])
+    ap.add_argument("--sparse", action="store_true",
+                    help="COO segment-sum GNN propagation instead of the "
+                         "dense (E, E) support (cuts transfer/memory ~500x)")
     ap.add_argument("--steps", type=int, default=5)
     ap.add_argument("--backward", action="store_true",
                     help="also time the actor/critic training step")
@@ -106,21 +113,31 @@ def main() -> int:
         inst.adj_ext, inst.ext_mask
     )
     variables = model.init(jax.random.PRNGKey(0), jnp.zeros((pad.e, 4)), support)
+    if args.sparse:
+        from multihop_offload_tpu.ops import coo_propagate, dense_to_coo
+
+        model = model.clone(propagate=coo_propagate)
+        support = dense_to_coo(np.asarray(support))
     apsp_fn = apsp_minplus_pallas if args.apsp == "pallas" else None
 
+    # inst/jobs/support as jit ARGUMENTS, not closure captures — captured
+    # arrays are baked into the HLO as literals (hundreds of MB at N=1000)
     @jax.jit
-    def eval_step(variables, key):
+    def eval_step(variables, inst, jobs, support, key):
         outcome, _ = forward_env(model, variables, inst, jobs, key,
                                  support=support, apsp_fn=apsp_fn)
         return outcome.delays.job_total, outcome.decision.dst
 
     key = jax.random.PRNGKey(1)
     t0 = time.time()
-    totals, decisions = jax.block_until_ready(eval_step(variables, key))
+    totals, decisions = jax.block_until_ready(
+        eval_step(variables, inst, jobs, support, key)
+    )
     t_compile = time.time() - t0
     t0 = time.time()
     for i in range(args.steps):
-        totals, decisions = eval_step(variables, jax.random.fold_in(key, i))
+        totals, decisions = eval_step(variables, inst, jobs, support,
+                                      jax.random.fold_in(key, i))
     jax.block_until_ready(totals)
     t_step = (time.time() - t0) / args.steps
 
@@ -139,16 +156,19 @@ def main() -> int:
 
     if args.backward:
         @jax.jit
-        def train_step(variables, key):
+        def train_step(variables, inst, jobs, support, key):
             return forward_backward(model, variables, inst, jobs, key,
                                     support=support, apsp_fn=apsp_fn)
 
         t0 = time.time()
-        outs = jax.block_until_ready(train_step(variables, key))
+        outs = jax.block_until_ready(
+            train_step(variables, inst, jobs, support, key)
+        )
         report["bwd_compile_s"] = round(time.time() - t0, 2)
         t0 = time.time()
         for i in range(args.steps):
-            outs = train_step(variables, jax.random.fold_in(key, i))
+            outs = train_step(variables, inst, jobs, support,
+                              jax.random.fold_in(key, i))
         jax.block_until_ready(outs.loss_critic)
         report["bwd_step_s"] = round((time.time() - t0) / args.steps, 4)
         report["loss_critic"] = round(float(outs.loss_critic), 2)

@@ -29,6 +29,10 @@ import pandas as pd
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from multihop_offload_tpu.utils.platform import apply_platform_env  # noqa: E402
+
+apply_platform_env()
+
 REF = "/root/reference"
 REF_DATA = os.path.join(REF, "data", "aco_data_ba_100")
 REF_MODEL_ROOT = os.path.join(REF, "model")

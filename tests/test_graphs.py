@@ -146,3 +146,34 @@ def test_generators_shapes():
         assert (adj == adj.T).all() and (np.diag(adj) == 0).all()
     adj, pos, nb = generators.connected_poisson_disk(25, seed=3)
     assert nx.is_connected(nx.from_numpy_array(adj))
+
+
+def test_spring_positions_cache(tmp_path):
+    """Layout caching (reference pickles under ../pos/,
+    `offloading_v3.py:152-163`): second call hits the cache; `fresh=True`
+    recomputes."""
+    from multihop_offload_tpu.graphs.generators import barabasi_albert, spring_positions
+
+    adj, _ = barabasi_albert(12, seed=4)
+    p1 = spring_positions(adj, seed=1, cache_dir=str(tmp_path), name="case12")
+    assert (tmp_path / "case12.npy").is_file()
+    p2 = spring_positions(adj, seed=999, cache_dir=str(tmp_path), name="case12")
+    np.testing.assert_array_equal(p1, p2)  # cache hit ignores the new seed
+    p3 = spring_positions(adj, seed=999, cache_dir=str(tmp_path), name="case12",
+                          fresh=True)
+    assert not np.array_equal(p1, p3)
+
+
+def test_init_distributed_single_process_noop(monkeypatch):
+    """With no cluster context in the environment the helper must return 0
+    without touching jax.distributed (this host exports axon's
+    TPU_WORKER_HOSTNAMES, which must be cleared to simulate a plain box)."""
+    from multihop_offload_tpu.parallel.mesh import init_distributed
+
+    for hint in (
+        "COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS",
+        "MEGASCALE_COORDINATOR_ADDRESS", "SLURM_JOB_ID",
+        "OMPI_COMM_WORLD_SIZE", "TPU_WORKER_HOSTNAMES", "CLOUD_TPU_TASK_ID",
+    ):
+        monkeypatch.delenv(hint, raising=False)
+    assert init_distributed() == 0

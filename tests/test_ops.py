@@ -92,18 +92,11 @@ def test_forward_env_accepts_pallas_apsp():
 
 
 def _fp_xla(adj, rates, cf, lam):
-    """Module-level XLA reference for the 10-iteration fixed point
-    (batched-aware), shared by every Pallas fixed-point test."""
-    import jax
+    """The framework's own fixed-point core (env.queueing) is the reference
+    for every Pallas fixed-point test — one definition, no drift."""
+    from multihop_offload_tpu.env.queueing import interference_fixed_point_raw
 
-    mu0 = rates / (cf + 1.0)
-
-    def body(mu, _):
-        busy = jnp.clip(lam / mu, 0.0, 1.0)
-        neighbor = jnp.einsum("...ij,...j->...i", adj, busy)
-        return rates / (1.0 + neighbor), None
-
-    return jax.lax.scan(body, mu0, None, length=10)[0]
+    return interference_fixed_point_raw(adj, rates, cf, lam, 10)
 
 
 def _random_conflict_case(rng, l, p=0.15):
@@ -166,3 +159,42 @@ def test_pallas_fixed_point_batched_values_and_grads():
         lambda lam: jnp.sum(_fp_xla(batched[0], batched[1], batched[2], lam) ** 2)
     )(batched[3])
     np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_exp), rtol=1e-10)
+
+
+def test_coo_propagation_matches_dense_chebnet():
+    """Same params, sparse COO propagation == dense propagation."""
+    import jax
+
+    from multihop_offload_tpu.models import ChebNet
+    from multihop_offload_tpu.models.chebconv import chebyshev_support
+    from multihop_offload_tpu.ops import coo_propagate, dense_to_coo
+
+    rng = np.random.default_rng(31)
+    e = 48
+    adj = (rng.uniform(size=(e, e)) < 0.15).astype(np.float64)
+    adj = np.triu(adj, 1)
+    adj = adj + adj.T
+    feats = jnp.asarray(rng.normal(size=(e, 4)))
+    support = chebyshev_support(jnp.asarray(adj), jnp.ones((e,), bool))
+    dense_model = ChebNet(num_layer=3, hidden=8, k=3, param_dtype=jnp.float64)
+    variables = dense_model.init(jax.random.PRNGKey(0), feats, support)
+    expect = dense_model.apply(variables, feats, support)
+
+    coo = dense_to_coo(np.asarray(support))
+    sparse_model = ChebNet(num_layer=3, hidden=8, k=3,
+                           param_dtype=jnp.float64, propagate=coo_propagate)
+    got = jax.jit(lambda v, x, s: sparse_model.apply(v, x, s))(
+        variables, feats, coo
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
+                               rtol=1e-12, atol=1e-12)
+
+
+def test_coo_matmul_matches_dense():
+    from multihop_offload_tpu.ops import coo_matmul, dense_to_coo
+
+    rng = np.random.default_rng(7)
+    m = rng.normal(size=(20, 20)) * (rng.uniform(size=(20, 20)) < 0.3)
+    x = rng.normal(size=(20, 5))
+    got = np.asarray(coo_matmul(dense_to_coo(m), jnp.asarray(x)))
+    np.testing.assert_allclose(got, m @ x, rtol=1e-12, atol=1e-12)
