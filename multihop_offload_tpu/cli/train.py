@@ -11,9 +11,11 @@ from multihop_offload_tpu.train.driver import Trainer
 
 
 def main(argv=None):
+    from multihop_offload_tpu.parallel.mesh import init_distributed
     from multihop_offload_tpu.utils.platform import apply_platform_env
 
     apply_platform_env()
+    init_distributed()  # multi-host bring-up; single-process no-op
     cfg = from_args(argv)
     trainer = Trainer(cfg)
     restored = trainer.try_restore()

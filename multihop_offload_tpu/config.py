@@ -61,6 +61,9 @@ class Config:
     pad_jobs: Optional[int] = None
     pad_servers: Optional[int] = None
     round_to: int = 8              # pad sizes up to a multiple of this
+    pad_buckets: int = 1           # size buckets per dataset: each bucket
+    #                                compiles once at its own pad shape
+    #                                (1 = single global shape)
     seed: int = 0                  # workload RNG (reference is unseeded)
     mesh_data: int = 1             # data-parallel mesh axis size
     mesh_graph: int = 1            # graph-partition (ring APSP) axis size
@@ -73,8 +76,13 @@ class Config:
     def jnp_dtype(self):
         import jax.numpy as jnp
 
-        return {"float32": jnp.float32, "float64": jnp.float64,
-                "bfloat16": jnp.bfloat16}[self.dtype]
+        table = {"float32": jnp.float32, "float64": jnp.float64,
+                 "bfloat16": jnp.bfloat16}
+        if self.dtype not in table:
+            raise ValueError(
+                f"unsupported dtype '{self.dtype}'; choose one of {sorted(table)}"
+            )
+        return table[self.dtype]
 
     def model_dir(self, root: Optional[str] = None) -> str:
         """Checkpoint directory; naming mirrors `AdHoc_train.py:59`."""

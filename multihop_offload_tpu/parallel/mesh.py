@@ -69,11 +69,13 @@ def init_distributed(
         return 0  # genuinely single-process: no cluster context detected
     try:
         jax.distributed.initialize()
-    except ValueError:
-        # jax raises ValueError iff the env hints don't resolve to an actual
-        # cluster spec (e.g. axon hosts export TPU_WORKER_HOSTNAMES with no
-        # coordinator) — that is "no cluster", not a failed bring-up
-        return 0
+    except ValueError as e:
+        if "coordinator_address" in str(e):
+            # hints that don't resolve to a cluster spec (e.g. axon hosts
+            # export TPU_WORKER_HOSTNAMES with no coordinator) — "no
+            # cluster", not a failed bring-up
+            return 0
+        raise  # real misconfiguration (inconsistent process ids etc.)
     # real bring-up failures (RuntimeError: coordinator unreachable, RPC
     # errors) propagate — never silently degrade a configured cluster into
     # n independent single-process runs

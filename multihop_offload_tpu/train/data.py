@@ -29,11 +29,38 @@ from multihop_offload_tpu.graphs.instance import (
 from multihop_offload_tpu.graphs.matio import CaseRecord, list_dataset, load_case_mat
 
 
+def _pad_for(records: List[CaseRecord], cfg: Config) -> PadSpec:
+    base = PadSpec.for_cases(
+        [(r.topo.n, r.topo.num_links, r.num_servers, r.mobile_nodes.size)
+         for r in records],
+        round_to=cfg.round_to,
+    )
+    return PadSpec(
+        n=cfg.pad_nodes or base.n, l=cfg.pad_links or base.l,
+        s=cfg.pad_servers or base.s, j=cfg.pad_jobs or base.j,
+    )
+
+
 @dataclasses.dataclass
 class DatasetCache:
+    """Parsed dataset with size-bucketed pad shapes.
+
+    Mixed-size datasets (the reference's span 20-110 nodes) padded to one
+    global shape waste up to (110/20)^3 of the APSP FLOPs on the smallest
+    cases; one shape per case would retrace XLA per file (the "recompile
+    storm" of SURVEY.md §7).  `cfg.pad_buckets` quantile-buckets the records
+    by node count: each bucket gets its own PadSpec, so there are exactly
+    `pad_buckets` compilations of each step and every case pays at most one
+    bucket's worth of padding.
+    """
+
     cfg: Config
     records: List[CaseRecord]
-    pad: PadSpec
+    pad: PadSpec              # elementwise max over buckets (a true global
+    #                           upper bound — buckets are keyed by node count
+    #                           but a low-n bucket can be denser in links)
+    pads: List[PadSpec]       # per-bucket, ascending node pad
+    bucket_of: List[int]      # record index -> bucket index
 
     @classmethod
     def load(cls, cfg: Config, datapath: Optional[str] = None) -> "DatasetCache":
@@ -42,16 +69,27 @@ class DatasetCache:
         if not names:
             raise FileNotFoundError(f"no .mat cases under {datapath}")
         records = [load_case_mat(os.path.join(datapath, n)) for n in names]
-        pad = PadSpec(
-            n=cfg.pad_nodes or PadSpec.round_up(max(r.topo.n for r in records), cfg.round_to),
-            l=cfg.pad_links or PadSpec.round_up(max(r.topo.num_links for r in records), cfg.round_to),
-            s=cfg.pad_servers or PadSpec.round_up(max(r.num_servers for r in records), cfg.round_to),
-            j=cfg.pad_jobs or PadSpec.round_up(max(r.mobile_nodes.size for r in records), cfg.round_to),
+        n_buckets = max(1, min(cfg.pad_buckets, len(records)))
+        order = np.argsort([r.topo.n for r in records], kind="stable")
+        groups = np.array_split(order, n_buckets)
+        groups = [g for g in groups if g.size]
+        pads, bucket_of = [], [0] * len(records)
+        for b, g in enumerate(groups):
+            pads.append(_pad_for([records[i] for i in g], cfg))
+            for i in g:
+                bucket_of[int(i)] = b
+        global_pad = PadSpec(
+            n=max(p.n for p in pads), l=max(p.l for p in pads),
+            s=max(p.s for p in pads), j=max(p.j for p in pads),
         )
-        return cls(cfg=cfg, records=records, pad=pad)
+        return cls(cfg=cfg, records=records, pad=global_pad, pads=pads,
+                   bucket_of=bucket_of)
 
     def __len__(self) -> int:
         return len(self.records)
+
+    def pad_of(self, idx: int) -> PadSpec:
+        return self.pads[self.bucket_of[idx]]
 
     def instance(self, idx: int, rng: np.random.Generator) -> Instance:
         """Freeze case `idx` with freshly realized link capacities
@@ -62,7 +100,7 @@ class DatasetCache:
         rates = sample_link_rates(rec.topo, rec.link_rates, rng=rng)
         return build_instance(
             rec.topo, rec.roles, rec.proc_bws, rates,
-            float(self.cfg.T), self.pad, dtype=self.cfg.jnp_dtype,
+            float(self.cfg.T), self.pad_of(idx), dtype=self.cfg.jnp_dtype,
         )
 
 

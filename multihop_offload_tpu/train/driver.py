@@ -230,7 +230,7 @@ class Trainer(_Harness):
                 rec = self.data.records[fid]
                 inst = self.data.instance(fid, self.rng)
                 jobsets, counts = sample_jobsets(
-                    rec, self.data.pad, cfg.num_instances, self.rng,
+                    rec, self.data.pad_of(fid), cfg.num_instances, self.rng,
                     cfg.arrival_scale, ul=cfg.ul_data, dl=cfg.dl_data,
                     dtype=cfg.jnp_dtype,
                 )
@@ -306,7 +306,7 @@ class Evaluator(_Harness):
             rec = self.data.records[fid]
             inst = self.data.instance(fid, self.rng)
             jobsets, counts = sample_jobsets(
-                rec, self.data.pad, cfg.num_instances, self.rng,
+                rec, self.data.pad_of(fid), cfg.num_instances, self.rng,
                 cfg.arrival_scale, ul=cfg.ul_data, dl=cfg.dl_data,
                 dtype=cfg.jnp_dtype,
             )

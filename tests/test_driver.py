@@ -83,3 +83,43 @@ def test_evaluator_csv_schema(tmp_path, tiny_dataset, monkeypatch):
     assert set(df["Algo"]) == {"baseline", "local", "GNN"}
     # local never congests more than baseline on these tiny loads
     assert np.isfinite(df["tau"]).all()
+
+
+def test_pad_buckets_partition_and_cover(tiny_dataset):
+    """Bucketed pads: every record's true sizes fit its bucket's pad, buckets
+    ascend, and bucket count respects the config."""
+    from multihop_offload_tpu.config import Config
+    from multihop_offload_tpu.train.data import DatasetCache
+
+    cfg = Config(datapath=tiny_dataset, pad_buckets=3, dtype="float64")
+    data = DatasetCache.load(cfg)
+    assert 1 <= len(data.pads) <= 3
+    for p_ in data.pads:
+        assert (data.pad.n >= p_.n and data.pad.l >= p_.l
+                and data.pad.s >= p_.s and data.pad.j >= p_.j)
+    for i, rec in enumerate(data.records):
+        pad = data.pad_of(i)
+        assert rec.topo.n <= pad.n
+        assert rec.topo.num_links <= pad.l
+        assert rec.num_servers <= pad.s
+        assert rec.mobile_nodes.size <= pad.j
+    ns = [p.n for p in data.pads]
+    assert ns == sorted(ns)
+
+
+def test_evaluator_with_buckets_matches_schema(tmp_path, tiny_dataset, monkeypatch):
+    """The bucketed Evaluator produces the same CSV schema; each bucket
+    compiles its own step."""
+    import pandas as pd
+
+    from multihop_offload_tpu.config import Config
+    from multihop_offload_tpu.train.driver import TEST_COLUMNS, Evaluator
+
+    monkeypatch.chdir(tmp_path)
+    cfg = Config(datapath=tiny_dataset, pad_buckets=2, num_instances=2,
+                 dtype="float64", epochs=1, seed=3)
+    ev = Evaluator(cfg)
+    csv = ev.run(files_limit=4, verbose=False)
+    df = pd.read_csv(csv)
+    assert list(df.columns) == TEST_COLUMNS
+    assert set(df["Algo"]) == {"baseline", "local", "GNN"}
