@@ -92,12 +92,13 @@ class _Harness:
 
     def _build_steps(self):
         model = self.model
+        prob = self.cfg.prob  # softmax-sample decisions (reference FLAGS.prob)
 
         def gnn_train_step(variables, mem, inst, jobsets, keys, explore):
             """vmapped forward_backward + in-program gradient memorization."""
             outs = jax.vmap(
                 lambda jb, k: forward_backward(model, variables, inst, jb, k,
-                                               explore=explore),
+                                               explore=explore, prob=prob),
                 in_axes=(0, 0),
             )(jobsets, keys)
 
@@ -115,7 +116,8 @@ class _Harness:
             )
             loc = jax.vmap(lambda jb: local_policy(inst, jb).job_total)(jobsets)
             gnn = jax.vmap(
-                lambda jb, k: forward_env(model, variables, inst, jb, k)[0].job_total
+                lambda jb, k: forward_env(model, variables, inst, jb, k,
+                                          prob=prob)[0].job_total
             )(jobsets, keys)
             return bl, loc, gnn
 

@@ -1,0 +1,116 @@
+"""Standalone quickstart — the working version of the reference's demo.
+
+The reference ships a hand-built 15-node demo in `offloading_v3.py:609-686`
+that crashes as shipped (it unpacks 2 of `run()`'s 3 return values,
+SURVEY.md §8).  This is that scenario, working: a small Poisson-disk network
+with a handful of servers/relays/tasks, evaluated under the congestion-
+agnostic baseline, local compute, and the GNN policy, with the chosen routes
+drawn to a figure (`utils.visualization`, the `plot_routes` equivalent).
+
+Usage:  python scripts/quickstart_demo.py [--out fig/quickstart.png]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from multihop_offload_tpu.utils.platform import apply_platform_env  # noqa: E402
+
+apply_platform_env()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=15)
+    ap.add_argument("--seed", type=int, default=3)
+    ap.add_argument("--load", type=float, default=0.15)
+    ap.add_argument("--out", default="fig/quickstart.png")
+    args = ap.parse_args()
+
+    import jax
+
+    from multihop_offload_tpu.agent import forward_env
+    from multihop_offload_tpu.config import Config
+    from multihop_offload_tpu.env import baseline_policy, local_policy
+    from multihop_offload_tpu.graphs import generators
+    from multihop_offload_tpu.graphs.instance import (
+        PadSpec, build_instance, build_jobset,
+    )
+    from multihop_offload_tpu.graphs.topology import build_topology, sample_link_rates
+    from multihop_offload_tpu.models import make_model
+    from multihop_offload_tpu.utils.visualization import draw_network
+
+    rng = np.random.default_rng(args.seed)
+    adj, pos, _ = generators.connected_poisson_disk(args.n, seed=args.seed)
+    topo = build_topology(adj, pos)
+
+    # the reference demo's cast: ~1/3 servers, a couple of relays, tasks on
+    # a third of the mobiles (`offloading_v3.py:635-648`)
+    roles = np.zeros(args.n, dtype=np.int32)
+    roles[rng.choice(args.n, max(2, args.n // 3), replace=False)] = 1
+    mobiles = np.flatnonzero(roles == 0)
+    roles[rng.choice(mobiles, min(2, mobiles.size), replace=False)] = 2
+    proc_bws = np.where(roles == 1, 100.0 * (1 + rng.pareto(2.0, args.n)),
+                        2.0)
+    proc_bws[roles == 2] = 0.0
+    rates = sample_link_rates(topo, rng.uniform(30, 70, topo.num_links), rng=rng)
+
+    pad = PadSpec.for_cases(
+        [(topo.n, topo.num_links, int((roles == 1).sum()),
+          int((roles == 0).sum()))]
+    )
+    inst = build_instance(topo, roles, proc_bws, rates, 1000.0, pad)
+    mobile = np.flatnonzero(roles == 0)
+    nj = max(1, mobile.size // 2)
+    jobs = build_jobset(mobile[:nj], args.load * rng.uniform(0.1, 0.5, nj),
+                        pad_jobs=pad.j)
+
+    cfg = Config()
+    model = make_model(cfg)
+    import jax.numpy as jnp
+
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((pad.e, 4)),
+                           inst.adj_ext)
+    key = jax.random.PRNGKey(1)
+
+    bl = baseline_policy(inst, jobs, key)
+    loc = local_policy(inst, jobs)
+    gnn, actor = forward_env(model, variables, inst, jobs, key)
+
+    mask = np.asarray(jobs.mask)
+    summary = {
+        "n": topo.n, "links": topo.num_links, "tasks": nj,
+        "servers": int((roles == 1).sum()), "relays": int((roles == 2).sum()),
+    }
+    for name, out in (("baseline", bl), ("local", loc), ("GNN", gnn)):
+        tot = np.asarray(out.job_total)[mask]
+        summary[f"tau_{name}"] = round(float(tot.mean()), 2)
+    print(json.dumps(summary))
+
+    # draw the GNN policy's realized routes (plot_routes equivalent)
+    dst = np.asarray(gnn.decision.dst)[:nj]
+    link_delay = np.asarray(actor.link_delay)[: topo.num_links]
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    node_delays = np.asarray(np.diagonal(actor.delay_matrix))[: topo.n]
+    node_delays = np.where(np.isfinite(node_delays), node_delays, 0.0)  # relays: inf
+    ax = draw_network(
+        topo, topo.pos, src_nodes=list(np.asarray(jobs.src)[:nj]),
+        dst_nodes=list(dst), edge_weights=link_delay,
+        node_delays=node_delays,
+    )
+    import matplotlib.pyplot as plt
+
+    plt.savefig(args.out, dpi=120, bbox_inches="tight")
+    print(f"routes figure -> {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -123,3 +123,27 @@ def test_evaluator_with_buckets_matches_schema(tmp_path, tiny_dataset, monkeypat
     df = pd.read_csv(csv)
     assert list(df.columns) == TEST_COLUMNS
     assert set(df["Algo"]) == {"baseline", "local", "GNN"}
+
+
+def test_prob_mode_plumbed_through_evaluator(tmp_path, tiny_dataset, monkeypatch):
+    """cfg.prob (reference FLAGS.prob softmax sampling) must change GNN
+    decisions; baseline/local are unaffected."""
+    import pandas as pd
+
+    from multihop_offload_tpu.config import Config
+    from multihop_offload_tpu.train.driver import Evaluator
+
+    monkeypatch.chdir(tmp_path)
+    out = {}
+    for prob in (False, True):
+        cfg = Config(datapath=tiny_dataset, num_instances=2, dtype="float64",
+                     seed=11, prob=prob, out=f"out_{prob}")
+        df = pd.read_csv(Evaluator(cfg).run(files_limit=2, verbose=False))
+        out[prob] = df
+    for algo in ("baseline", "local"):
+        a = out[False][out[False].Algo == algo]["tau"].to_numpy()
+        b = out[True][out[True].Algo == algo]["tau"].to_numpy()
+        np.testing.assert_allclose(a, b)
+    g0 = out[False][out[False].Algo == "GNN"]["tau"].to_numpy()
+    g1 = out[True][out[True].Algo == "GNN"]["tau"].to_numpy()
+    assert not np.allclose(g0, g1)  # softmax sampling changes decisions
