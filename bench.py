@@ -3,10 +3,15 @@
 Measures the batched `forward_backward` step — the exact computation the
 reference times per instance in its drivers (`AdHoc_test.py:150-156`, ~0.11 s
 => ~9 episodes/sec on its single device, BASELINE.md) — over a vmapped batch
-of real reference test networks (aco_data_ba_100 sizes 20-110, load 0.15) on
-whatever accelerator JAX selects (the TPU chip under the driver).
+of real reference test networks (aco_data_ba_100 sizes 20-110, load 0.15).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "platform"}.
+
+Resilience (round-1 postmortem): this host's remote TPU backend can be
+Unavailable or hang during init, which round 1 turned into a stack trace and
+a dead artifact.  The measurement therefore runs in a wall-clock-bounded
+subprocess; the parent retries the accelerator with backoff, falls back to a
+forced-CPU run, and on total failure still emits a diagnostic JSON line.
 """
 
 from __future__ import annotations
@@ -20,6 +25,13 @@ import numpy as np
 
 REFERENCE_EPISODES_PER_SEC = 9.0  # BASELINE.md: ~0.11 s/episode, single device
 REFERENCE_DATA = "/root/reference/data/aco_data_ba_100"
+
+_CHILD_ENV = "_MHO_BENCH_CHILD"
+_TOTAL_TIMEOUT_S = float(os.environ.get("BENCH_TOTAL_TIMEOUT", 1100))
+_ATTEMPT_TIMEOUT_S = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", 480))
+_TPU_ATTEMPTS = int(os.environ.get("BENCH_TPU_ATTEMPTS", 2))
+_BACKOFF_S = 20.0
+_CPU_RESERVE_S = 300.0  # always leave room for the forced-CPU fallback
 
 
 def _load_cases(max_cases: int, rng):
@@ -45,7 +57,12 @@ def _load_cases(max_cases: int, rng):
     return recs
 
 
-def main():
+def measure():
+    """The actual benchmark; prints the JSON line.  Runs in the child."""
+    from multihop_offload_tpu.utils.platform import apply_platform_env
+
+    apply_platform_env()
+
     import jax
     import jax.numpy as jnp
 
@@ -55,6 +72,8 @@ def main():
     )
     from multihop_offload_tpu.graphs.topology import sample_link_rates
     from multihop_offload_tpu.models import ChebNet, load_reference_checkpoint
+
+    platform = jax.default_backend()
 
     num_networks = int(os.environ.get("BENCH_NETWORKS", 16))
     per_network = int(os.environ.get("BENCH_INSTANCES", 4))
@@ -119,7 +138,83 @@ def main():
         "value": round(eps, 2),
         "unit": "episodes/sec/chip",
         "vs_baseline": round(eps / REFERENCE_EPISODES_PER_SEC, 2),
+        "platform": platform,
     }))
+
+
+def _run_child(extra_env: dict, timeout_s: float):
+    """Run `measure()` in a bounded subprocess; return (ok, json_line, diag)."""
+    from multihop_offload_tpu.utils.subproc import run_bounded_child
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    res = run_bounded_child(
+        [sys.executable, os.path.join(here, "bench.py")],
+        timeout_s=timeout_s,
+        extra_env={_CHILD_ENV: "1", **extra_env},
+        cwd=here,
+    )
+    if res.timed_out:
+        tail = (res.stderr or res.stdout).strip().splitlines()[-4:]
+        return False, None, (
+            f"timeout after {timeout_s:.0f}s; last output: " + " | ".join(tail)
+        )
+    if not res.ok:
+        tail = (res.stderr or res.stdout).strip().splitlines()[-6:]
+        return False, None, f"rc={res.returncode}: " + " | ".join(tail)
+    for line in reversed(res.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{") and line.endswith("}"):
+            try:
+                json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            return True, line, None
+    return False, None, "child produced no JSON line"
+
+
+def main():
+    if os.environ.get(_CHILD_ENV):
+        measure()
+        return
+
+    deadline = time.time() + _TOTAL_TIMEOUT_S
+    diags = []
+    # accelerator attempts (whatever backend the host selects, i.e. the TPU
+    # chip under the driver) with backoff between retries; every attempt's
+    # budget respects the total deadline less the CPU-fallback reserve
+    for attempt in range(_TPU_ATTEMPTS):
+        budget = min(_ATTEMPT_TIMEOUT_S, deadline - time.time() - _CPU_RESERVE_S)
+        if budget < 60:
+            diags.append(f"accel attempt {attempt + 1}: skipped (budget spent)")
+            break
+        ok, line, diag = _run_child({}, budget)
+        if ok:
+            print(line)
+            return
+        diags.append(f"accel attempt {attempt + 1}: {diag}")
+        if attempt + 1 < _TPU_ATTEMPTS:
+            time.sleep(_BACKOFF_S)
+
+    # forced-CPU fallback: still a valid measurement, clearly labelled
+    budget = max(60.0, deadline - time.time())
+    ok, line, diag = _run_child({"JAX_PLATFORMS": "cpu"}, budget)
+    if ok:
+        rec = json.loads(line)
+        rec["note"] = "accelerator unavailable; CPU fallback — " + "; ".join(diags)
+        print(json.dumps(rec))
+        return
+    diags.append(f"cpu fallback: {diag}")
+
+    # total failure: diagnostic JSON, never a bare stack trace — but a
+    # nonzero exit so rc-gated callers don't record success
+    print(json.dumps({
+        "metric": "gnn_actor_critic_episodes_per_sec",
+        "value": None,
+        "unit": "episodes/sec/chip",
+        "vs_baseline": None,
+        "error": "; ".join(diags),
+    }))
+    sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,74 @@
+"""Wall-clock-bounded child processes for flaky-backend isolation.
+
+The driver entry points (`bench.py`, `__graft_entry__.dryrun_multichip`) must
+survive a remote TPU backend that can hang during *initialization* — a hang
+no in-process try/except can bound.  The only robust shape is: run the
+measurement in a subprocess with a sentinel env var, kill it at a deadline,
+and keep whatever partial output it produced for diagnostics.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from dataclasses import dataclass
+from typing import Mapping, Optional, Sequence
+
+
+@dataclass
+class ChildResult:
+    returncode: Optional[int]  # None when killed at the deadline
+    stdout: str
+    stderr: str
+    timed_out: bool
+
+    @property
+    def ok(self) -> bool:
+        return self.returncode == 0
+
+
+def _as_text(b) -> str:
+    if b is None:
+        return ""
+    return b.decode(errors="replace") if isinstance(b, bytes) else b
+
+
+def run_bounded_child(
+    argv: Sequence[str],
+    *,
+    timeout_s: float,
+    extra_env: Optional[Mapping[str, str]] = None,
+    cwd: Optional[str] = None,
+) -> ChildResult:
+    """Run `argv` with env overrides, bounded by `timeout_s`.
+
+    Never raises on timeout or nonzero exit — the caller decides; partial
+    stdout/stderr are preserved in both cases.
+    """
+    env = dict(os.environ)
+    if extra_env:
+        env.update(extra_env)
+    try:
+        proc = subprocess.run(
+            list(argv), cwd=cwd, env=env, capture_output=True, text=True,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired as e:
+        return ChildResult(
+            returncode=None,
+            stdout=_as_text(e.stdout),
+            stderr=_as_text(e.stderr),
+            timed_out=True,
+        )
+    return ChildResult(
+        returncode=proc.returncode,
+        stdout=proc.stdout,
+        stderr=proc.stderr,
+        timed_out=False,
+    )
+
+
+def python_child_argv(code: str) -> list[str]:
+    """argv for running a snippet under the current interpreter."""
+    return [sys.executable, "-c", code]
