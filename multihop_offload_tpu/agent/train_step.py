@@ -169,14 +169,21 @@ def forward_backward(
     mse_weight: float = 0.001,
     critic_weight: float = 1.0,
     apsp_fn=None,
+    dropout_rng: jax.Array | None = None,
 ) -> TrainStepOutput:
     if support is None:
         support = inst.adj_ext
     apsp = apsp_fn or apsp_minplus
 
     # --- 1. actor forward under VJP -------------------------------------
+    # dropout active iff a dropout key is supplied (the reference applies
+    # Dropout(FLAGS.dropout) before every layer in training mode,
+    # `gnn_offloading_agent.py:94`; default dropout=0)
     def actor_fn(params_tree):
-        out = actor_delay_matrix(model, params_tree, inst, jobs, support)
+        out = actor_delay_matrix(
+            model, params_tree, inst, jobs, support,
+            deterministic=dropout_rng is None, dropout_rng=dropout_rng,
+        )
         return out.delay_matrix, out
 
     dmtx, vjp_fn, actor = jax.vjp(actor_fn, variables, has_aux=True)

@@ -42,20 +42,26 @@ def _graph_apsp_fn(mesh: Mesh):
     return None
 
 
-def make_dp_train_step(model, optimizer, mesh: Mesh, mode: str = "mean"):
+def make_dp_train_step(model, optimizer, mesh: Mesh, mode: str = "mean",
+                       dropout: bool = False):
     """Batched episode step: (variables, opt_state|mem, insts, jobsets, keys,
     explore) with the episode batch sharded over 'data'.
 
-    Batch axis length must be divisible by the data-axis size.
+    Batch axis length must be divisible by the data-axis size.  `dropout`
+    mirrors the single-host Trainer's `cfg.dropout > 0` wiring (a per-episode
+    dropout stream folded from the episode key).
     """
     apsp_fn = _graph_apsp_fn(mesh)
 
     def per_device(variables, insts, jobsets, keys, explore):
-        outs = jax.vmap(
-            lambda i, jb, k: forward_backward(
-                model, variables, i, jb, k, explore=explore, apsp_fn=apsp_fn
+        def one(i, jb, k):
+            dk = jax.random.fold_in(k, 1) if dropout else None
+            return forward_backward(
+                model, variables, i, jb, k, explore=explore, apsp_fn=apsp_fn,
+                dropout_rng=dk,
             )
-        )(insts, jobsets, keys)
+
+        outs = jax.vmap(one)(insts, jobsets, keys)
         return outs
 
     if mode == "mean":

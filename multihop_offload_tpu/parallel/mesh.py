@@ -60,22 +60,30 @@ def init_distributed(
             process_id=process_id,
         )
         return jax.process_index()
-    cluster_hints = (
+    # strong hints name a coordinator outright; weak hints merely suggest a
+    # scheduler/pod context that may not resolve to a cluster spec (e.g.
+    # axon hosts export TPU_WORKER_HOSTNAMES with no coordinator)
+    strong_hints = (
         "COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS",
-        "MEGASCALE_COORDINATOR_ADDRESS", "SLURM_JOB_ID",
-        "OMPI_COMM_WORLD_SIZE", "TPU_WORKER_HOSTNAMES", "CLOUD_TPU_TASK_ID",
+        "MEGASCALE_COORDINATOR_ADDRESS",
     )
-    if not any(h in os.environ for h in cluster_hints):
+    weak_hints = (
+        "SLURM_JOB_ID", "OMPI_COMM_WORLD_SIZE", "TPU_WORKER_HOSTNAMES",
+        "CLOUD_TPU_TASK_ID",
+    )
+    has_strong = any(h in os.environ for h in strong_hints)
+    if not has_strong and not any(h in os.environ for h in weak_hints):
         return 0  # genuinely single-process: no cluster context detected
     try:
         jax.distributed.initialize()
-    except ValueError as e:
-        if "coordinator_address" in str(e):
-            # hints that don't resolve to a cluster spec (e.g. axon hosts
-            # export TPU_WORKER_HOSTNAMES with no coordinator) — "no
-            # cluster", not a failed bring-up
+    except ValueError:
+        if not has_strong:
+            # auto-detection could not assemble a cluster spec from weak
+            # hints alone — "no cluster", not a failed bring-up (no
+            # exception-text parsing: ValueError is jax.distributed's
+            # incomplete-spec signal; RuntimeErrors still propagate below)
             return 0
-        raise  # real misconfiguration (inconsistent process ids etc.)
+        raise  # a named coordinator that fails to resolve IS misconfiguration
     # real bring-up failures (RuntimeError: coordinator unreachable, RPC
     # errors) propagate — never silently degrade a configured cluster into
     # n independent single-process runs

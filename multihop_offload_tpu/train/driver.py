@@ -68,7 +68,12 @@ def _init_params(cfg: Config, model, example, model_dir: Optional[str]):
 
 
 class _Harness:
-    """Shared model/optimizer/data plumbing for Trainer and Evaluator."""
+    """Shared model/optimizer/data plumbing for Trainer and Evaluator.
+
+    `memory_size=0` skips the gradient-replay buffer (the Evaluator never
+    replays — the reference's eval driver allocates a 1000-slot memory it
+    never reads, `AdHoc_test.py:31`, a vestige we don't reproduce).
+    """
 
     def __init__(self, cfg: Config, datapath: Optional[str] = None,
                  memory_size: Optional[int] = None):
@@ -82,7 +87,10 @@ class _Harness:
         self.variables = _init_params(cfg, self.model, (feats0, support0), self.model_dir)
         self.optimizer = make_optimizer(cfg)
         self.opt_state = self.optimizer.init(self.variables["params"])
-        self.memory = replay_init(
+        # multi-host runs share a filesystem: only process 0 writes CSVs,
+        # checkpoints, and TB events (every process computes identically)
+        self.is_host0 = jax.process_index() == 0
+        self.memory = None if memory_size == 0 else replay_init(
             self.variables["params"], memory_size or cfg.memory_size
         )
         self.mem_count = 0
@@ -93,14 +101,19 @@ class _Harness:
     def _build_steps(self):
         model = self.model
         prob = self.cfg.prob  # softmax-sample decisions (reference FLAGS.prob)
+        use_dropout = self.cfg.dropout > 0
 
         def gnn_train_step(variables, mem, inst, jobsets, keys, explore):
             """vmapped forward_backward + in-program gradient memorization."""
-            outs = jax.vmap(
-                lambda jb, k: forward_backward(model, variables, inst, jb, k,
-                                               explore=explore, prob=prob),
-                in_axes=(0, 0),
-            )(jobsets, keys)
+
+            def one(jb, k):
+                # distinct streams for the decision path and dropout masks
+                dk = jax.random.fold_in(k, 1) if use_dropout else None
+                return forward_backward(model, variables, inst, jb, k,
+                                        explore=explore, prob=prob,
+                                        dropout_rng=dk)
+
+            outs = jax.vmap(one, in_axes=(0, 0))(jobsets, keys)
 
             def remember(m, i):
                 g = jax.tree_util.tree_map(lambda x: x[i], outs.grads["params"])
@@ -133,6 +146,9 @@ class _Harness:
         return jnp.stack(keys)
 
     def save(self, step: int):
+        # NOT gated on is_host0: orbax's CheckpointManager is multihost-aware
+        # (cross-process barriers inside save/wait_until_finished) — every
+        # process must enter, orbax itself restricts writing to the primary
         state = {
             "params": self.variables["params"],
             "opt_state": self.opt_state,
@@ -223,7 +239,7 @@ class Trainer(_Harness):
         explore = cfg.explore
         losses = []
         gidx = 0
-        tb = ScalarLogger(cfg.tb_logdir)
+        tb = ScalarLogger(cfg.tb_logdir if self.is_host0 else None)
         for epoch in range(epochs if epochs is not None else cfg.epochs):
             order = self.rng.permutation(len(self.data))
             if files_limit:
@@ -281,7 +297,10 @@ class Trainer(_Harness):
                         tb.log_scalar("mse_loss", float(jnp.nanmean(loss_m)), gidx)
                     losses = []
                 gidx += 1
-                pd.DataFrame(rows, columns=TRAIN_COLUMNS).to_csv(csv_path, index=False)
+                if self.is_host0:
+                    pd.DataFrame(rows, columns=TRAIN_COLUMNS).to_csv(
+                        csv_path, index=False
+                    )
         tb.flush()
         return csv_path
 
@@ -290,7 +309,7 @@ class Evaluator(_Harness):
     """The `bash/test.sh` -> `AdHoc_test.py` workflow (no weight updates)."""
 
     def __init__(self, cfg: Config, datapath: Optional[str] = None):
-        super().__init__(cfg, datapath, memory_size=1000)
+        super().__init__(cfg, datapath, memory_size=0)
 
     def run(self, files_limit: Optional[int] = None, out_dir: Optional[str] = None,
             verbose: bool = True):
@@ -327,5 +346,6 @@ class Evaluator(_Harness):
             if verbose and fid % 50 == 0:
                 print(f"[{fid + 1}/{n_files}] {rec.filename} "
                       f"({(time.time() - t0):.3f}s for {3 * cfg.num_instances} evals)")
-            pd.DataFrame(rows, columns=TEST_COLUMNS).to_csv(csv_path, index=False)
+            if self.is_host0:
+                pd.DataFrame(rows, columns=TEST_COLUMNS).to_csv(csv_path, index=False)
         return csv_path

@@ -26,7 +26,16 @@ class ScalarLogger:
             return
         try:
             import tensorflow as tf  # noqa: PLC0415
-        except ImportError:  # pragma: no cover - TF missing: degrade quietly
+        except ImportError:  # pragma: no cover - TF missing
+            # the user explicitly asked for TB logging: degrade loudly
+            import warnings
+
+            warnings.warn(
+                f"tb_logdir={logdir!r} requested but TensorFlow is not "
+                "importable — TensorBoard scalars will NOT be recorded",
+                RuntimeWarning,
+                stacklevel=2,
+            )
             return
         # the user asked for logging: a bad logdir must surface, not vanish
         self._writer = tf.summary.create_file_writer(logdir)

@@ -57,7 +57,12 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--files", type=int, default=None, help="limit network files")
     ap.add_argument("--dtype", default="float32")
-    ap.add_argument("--out", default="out")
+    ap.add_argument("--out", default="out",
+                    help="scratch dir for the Evaluator's CSV (gitignored)")
+    ap.add_argument("--record", default=None,
+                    help="directory of record for the validation JSON "
+                         "(default: <repo>/validation when run in-repo, "
+                         "else --out)")
     ap.add_argument("--scale", type=float, default=0.15,
                     help="arrival load scale; the reference shipped runs at "
                          "0.15 and 0.20")
@@ -101,8 +106,13 @@ def main() -> int:
             rel = (ov - rv) / rv if rv else float("nan")
             print(f"{algo:<10} {metric:<24} {rv:>12.4f} {ov:>12.4f} {rel:>+8.1%}")
 
-    path = os.path.join(args.out, f"validation_vs_reference_load_{args.scale:.2f}.json")
-    os.makedirs(args.out, exist_ok=True)
+    record = args.record
+    if record is None:
+        repo_validation = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "validation")
+        record = repo_validation if os.path.isdir(repo_validation) else args.out
+    path = os.path.join(record, f"validation_vs_reference_load_{args.scale:.2f}.json")
+    os.makedirs(record, exist_ok=True)
     with open(path, "w") as f:
         json.dump(report, f, indent=2)
     print(f"\nwrote {path}")

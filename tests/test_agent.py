@@ -271,3 +271,30 @@ def test_max_norm_constraint_matches_keras_formula():
         np.clip(norms, 0, 1.0) / (1e-7 + norms)
     )
     np.testing.assert_allclose(out, expect, rtol=1e-12)
+
+
+def test_dropout_active_in_training_path(setup):
+    """`cfg.dropout > 0` must actually perturb the training grads
+    (reference applies Dropout before every layer in training mode,
+    `gnn_offloading_agent.py:94`)."""
+    rec, ca, inst, js, jobs_list, model, variables, pad = setup
+    dmodel = ChebNet(param_dtype=jnp.float64, dropout=0.5)
+
+    def grads(dropout_rng):
+        out = forward_backward(dmodel, variables, inst, js,
+                               jax.random.PRNGKey(3), dropout_rng=dropout_rng)
+        return jax.flatten_util.ravel_pytree(out.grads)[0]
+
+    g_det = grads(None)
+    g_a = grads(jax.random.PRNGKey(10))
+    g_b = grads(jax.random.PRNGKey(11))
+    # no dropout key -> deterministic == the dropout-free model
+    out0 = forward_backward(model, variables, inst, js, jax.random.PRNGKey(3))
+    np.testing.assert_allclose(
+        np.asarray(g_det),
+        np.asarray(jax.flatten_util.ravel_pytree(out0.grads)[0]),
+    )
+    # dropout keys perturb grads, and different keys differ
+    assert not np.allclose(np.asarray(g_det), np.asarray(g_a))
+    assert not np.allclose(np.asarray(g_a), np.asarray(g_b))
+    assert np.isfinite(np.asarray(g_a)).all()
