@@ -37,6 +37,8 @@ class Config:
 
     # ---- reference driver-level constants (AdHoc_train.py) -----------------
     num_instances: int = 10        # job-placement instances per network
+    files_limit: Optional[int] = None  # cap network files visited per epoch
+    #                                (bounded training slices; None = all)
     explore: float = 0.1           # driver-level epsilon-greedy exploration
     explore_decay: float = 0.99
     memory_size: int = 5000        # gradient-replay capacity (train); 1000 test

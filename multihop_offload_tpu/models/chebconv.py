@@ -41,6 +41,7 @@ class ChebConv(nn.Module):
     # halo-exchange matmul to row-shard the graph across a mesh axis while
     # reusing the exact same parameters.
     propagate: Optional[Callable] = None
+    bias_init: Callable = nn.initializers.zeros
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, support: jnp.ndarray) -> jnp.ndarray:
@@ -59,7 +60,7 @@ class ChebConv(nn.Module):
                 t_prev2, t_prev = t_prev, t_cur
         if self.use_bias:
             out = out + self.param(
-                "bias", nn.initializers.zeros, (self.channels,), self.param_dtype
+                "bias", self.bias_init, (self.channels,), self.param_dtype
             )
         return out
 
@@ -77,6 +78,14 @@ class ChebNet(nn.Module):
     leaky_alpha: float = 0.2
     param_dtype: jnp.dtype = jnp.float32
     propagate: Optional[Callable] = None
+    # Final-layer bias init.  The reference zero-inits every bias (Keras
+    # default), which leaves the single relu output unit dead-at-birth for
+    # ~half of all seeds (one random hyperplane over strongly correlated
+    # hidden features — measured 4/8 seeds emit lambda == 0 on every node,
+    # with exactly-zero gradients forever).  A small positive bias makes
+    # fresh inits trainable; imported reference checkpoints overwrite it, so
+    # checkpoint parity is untouched.
+    out_bias_init: float = 0.1
 
     @nn.compact
     def __call__(
@@ -93,6 +102,10 @@ class ChebNet(nn.Module):
                 k=self.k,
                 param_dtype=self.param_dtype,
                 propagate=self.propagate,
+                bias_init=(
+                    nn.initializers.constant(self.out_bias_init)
+                    if last else nn.initializers.zeros
+                ),
                 name=f"cheb_{layer}",
             )(x, support)
             x = nn.relu(x) if last else nn.leaky_relu(x, self.leaky_alpha)
@@ -132,6 +145,31 @@ def chebyshev_support(
     else:
         lmax_val = jnp.asarray(lmax, dtype=adj.dtype)
     return (2.0 / lmax_val) * lap - eye
+
+
+def ensure_alive_output(model, variables, feats, support):
+    """Data-dependent init fixup for the dead-relu-at-birth pathology.
+
+    The stack's single relu output unit sees pre-activations dominated by
+    the (unnormalized, reference-faithful) link-rate feature, so across
+    nodes they share one sign — a fresh init is all-alive or all-dead by a
+    coin flip (measured ~half of seeds; a dead output has exactly-zero
+    gradients and can never train).  If the probe emits zero everywhere,
+    negate the final layer's kernel and bias: glorot is sign-symmetric, so
+    the flipped init is drawn from the same distribution, with positive
+    pre-activations.  Imported checkpoints never pass through here.
+    """
+    lam = model.apply(variables, feats, support)
+    if bool((lam > 0).any()):
+        return variables
+    params = dict(variables["params"])
+    last = f"cheb_{model.num_layer - 1}"
+    params[last] = jax.tree_util.tree_map(lambda w: -w, params[last])
+    fixed = {**variables, "params": params}
+    lam = model.apply(fixed, feats, support)
+    if not bool((lam > 0).any()):  # pragma: no cover - both signs dead
+        raise RuntimeError("output unit dead under both kernel signs")
+    return fixed
 
 
 def make_model(cfg: Config) -> ChebNet:

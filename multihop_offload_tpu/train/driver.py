@@ -55,16 +55,17 @@ TEST_COLUMNS = [
 
 def _init_params(cfg: Config, model, example, model_dir: Optional[str]):
     """Load reference-format TF weights if present (auto-resume semantics of
-    `AdHoc_train.py:62-65`), else fresh glorot init."""
+    `AdHoc_train.py:62-65`), else fresh glorot init.  Returns (variables,
+    loaded_from_checkpoint)."""
     feats, support = example
     if model_dir and os.path.isfile(os.path.join(model_dir, "checkpoint")):
         try:
             vs = load_reference_checkpoint(model_dir, dtype=cfg.jnp_dtype)
             print(f"loaded reference-format weights from {model_dir}")
-            return vs
+            return vs, True
         except Exception as e:  # pragma: no cover
             print(f"unable to load {model_dir}: {e}")
-    return model.init(jax.random.PRNGKey(cfg.seed), feats, support)
+    return model.init(jax.random.PRNGKey(cfg.seed), feats, support), False
 
 
 class _Harness:
@@ -84,7 +85,27 @@ class _Harness:
         feats0 = jnp.zeros((pad.e, 4), cfg.jnp_dtype)
         support0 = jnp.zeros((pad.e, pad.e), cfg.jnp_dtype)
         self.model_dir = cfg.model_dir()
-        self.variables = _init_params(cfg, self.model, (feats0, support0), self.model_dir)
+        self.variables, loaded = _init_params(
+            cfg, self.model, (feats0, support0), self.model_dir
+        )
+        if not loaded and len(self.data):
+            # fresh init: probe with real features and flip a dead output
+            # unit's sign (models.chebconv.ensure_alive_output)
+            from multihop_offload_tpu.agent.actor import build_ext_features
+            from multihop_offload_tpu.models.chebconv import ensure_alive_output
+
+            probe_rng = np.random.default_rng(cfg.seed)
+            inst0 = self.data.instance(0, probe_rng)
+            js0, _ = sample_jobsets(
+                self.data.records[0], self.data.pad_of(0), 1, probe_rng,
+                cfg.arrival_scale, ul=cfg.ul_data, dl=cfg.dl_data,
+                dtype=cfg.jnp_dtype,
+            )
+            jb0 = jax.tree_util.tree_map(lambda x: x[0], js0)
+            self.variables = ensure_alive_output(
+                self.model, self.variables,
+                build_ext_features(inst0, jb0), inst0.adj_ext,
+            )
         self.optimizer = make_optimizer(cfg)
         self.opt_state = self.optimizer.init(self.variables["params"])
         # multi-host runs share a filesystem: only process 0 writes CSVs,
@@ -228,6 +249,8 @@ class Trainer(_Harness):
     def run(self, epochs: Optional[int] = None, files_limit: Optional[int] = None,
             out_dir: Optional[str] = None, verbose: bool = True):
         cfg = self.cfg
+        if files_limit is None:
+            files_limit = cfg.files_limit
         out_dir = out_dir or cfg.out
         os.makedirs(out_dir, exist_ok=True)
         dataset_tag = os.path.normpath(cfg.datapath).split(os.sep)[-1]

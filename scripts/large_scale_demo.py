@@ -90,7 +90,9 @@ def main() -> int:
     )
     from multihop_offload_tpu.models import make_model
     from multihop_offload_tpu.models.chebconv import chebyshev_support
-    from multihop_offload_tpu.ops.minplus import apsp_minplus_pallas
+    from multihop_offload_tpu.ops.minplus import (
+        apsp_minplus_pallas, pallas_apsp_path,
+    )
 
     rng = np.random.default_rng(args.seed)
     t0 = time.time()
@@ -118,7 +120,10 @@ def main() -> int:
 
         model = model.clone(propagate=coo_propagate)
         support = dense_to_coo(np.asarray(support))
+    # report the path actually executed, not just the one requested: the
+    # pallas dispatcher delegates to XLA beyond its validated size range
     apsp_fn = apsp_minplus_pallas if args.apsp == "pallas" else None
+    apsp_path = pallas_apsp_path(pad.n) if args.apsp == "pallas" else "xla"
 
     # inst/jobs/support as jit ARGUMENTS, not closure captures — captured
     # arrays are baked into the HLO as literals (hundreds of MB at N=1000)
@@ -144,7 +149,7 @@ def main() -> int:
     report = {
         "metric": "large_scale_forward_env",
         "n": topo.n, "links": topo.num_links, "ext_slots": int(pad.e),
-        "jobs": nj, "gtype": args.gtype, "cheb_k": args.k, "apsp": args.apsp,
+        "jobs": nj, "gtype": args.gtype, "cheb_k": args.k, "apsp": apsp_path,
         "build_s": round(t_build, 3), "compile_s": round(t_compile, 2),
         "step_s": round(t_step, 4),
         "tau": round(float(np.asarray(totals)[:nj].mean()), 3),
@@ -153,6 +158,28 @@ def main() -> int:
             float((np.asarray(decisions)[:nj] != np.asarray(jobs.src)[:nj]).mean()), 4
         ),
     }
+
+    if apsp_path != "xla":
+        # standalone APSP timing: the requested pallas path vs the XLA
+        # squaring on the identical weight matrix
+        from multihop_offload_tpu.env.apsp import apsp_minplus
+
+        wmat = jnp.where(inst.adj > 0, 1.0 / jnp.maximum(inst.adj, 1e-9),
+                         jnp.inf)
+        timings = {}
+        for name, fn in (("pallas", apsp_minplus_pallas), ("xla", apsp_minplus)):
+            if name == "pallas" and apsp_path == "xla-fallback":
+                continue
+            run = jax.jit(fn)
+            jax.block_until_ready(run(wmat))  # compile
+            t0 = time.time()
+            for _ in range(max(args.steps, 3)):
+                out = run(wmat)
+            jax.block_until_ready(out)
+            timings[f"apsp_{name}_ms"] = round(
+                (time.time() - t0) / max(args.steps, 3) * 1e3, 2
+            )
+        report.update(timings)
 
     if args.backward:
         @jax.jit

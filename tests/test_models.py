@@ -113,3 +113,43 @@ def test_checkpoint_export_roundtrip(tmp_path):
             back["params"][f"cheb_{i}"]["kernel"],
             variables["params"][f"cheb_{i}"]["kernel"],
         )
+
+
+def test_ensure_alive_output_revives_dead_init():
+    """~Half of fresh inits emit lambda == 0 everywhere (dead final relu,
+    zero grads forever); the data-dependent sign flip must revive them
+    without changing the init distribution's support."""
+    import jax
+    import jax.numpy as jnp
+    from multihop_offload_tpu.models import ChebNet
+    from multihop_offload_tpu.models.chebconv import ensure_alive_output
+
+    rng = np.random.default_rng(0)
+    feats = np.zeros((64, 4), np.float32)
+    feats[:, 0] = rng.integers(0, 2, 64)
+    feats[:, 1] = rng.uniform(20, 100, 64)
+    feats[:, 2] = rng.uniform(0, 8, 64)
+    feats[:, 3] = rng.integers(0, 2, 64)
+    feats = jnp.asarray(feats)
+    sup = jnp.zeros((64, 64), jnp.float32)
+    model = ChebNet(param_dtype=jnp.float32)
+    revived = 0
+    for seed in range(8):
+        vs = model.init(jax.random.PRNGKey(seed), feats, sup)
+        dead = not bool((model.apply(vs, feats, sup) > 0).any())
+        fixed = ensure_alive_output(model, vs, feats, sup)
+        lam = model.apply(fixed, feats, sup)
+        assert bool((lam > 0).any()), f"seed {seed} still dead"
+        if dead:
+            revived += 1
+            # untouched layers identical; final layer exactly negated
+            for i in range(4):
+                np.testing.assert_array_equal(
+                    np.asarray(vs["params"][f"cheb_{i}"]["kernel"]),
+                    np.asarray(fixed["params"][f"cheb_{i}"]["kernel"]),
+                )
+            np.testing.assert_array_equal(
+                -np.asarray(vs["params"]["cheb_4"]["kernel"]),
+                np.asarray(fixed["params"]["cheb_4"]["kernel"]),
+            )
+    assert revived >= 2  # the pathology is common enough to matter
