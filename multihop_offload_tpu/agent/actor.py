@@ -96,6 +96,29 @@ def lambdas_to_delay_matrix(inst: Instance, lam: jnp.ndarray) -> ActorOutput:
     )
 
 
+def compat_cycled_diagonal(inst: Instance, node_delay: jnp.ndarray) -> jnp.ndarray:
+    """The reference's diagonal-cycling bug, reproduced for A/B validation.
+
+    `forward` fills the NumPy delay matrix's diagonal with the compute-node
+    delay vector via `np.fill_diagonal(delay_mtx_np, node_delay_np)`
+    (`gnn_offloading_agent.py:269`); when relays exist that vector is SHORTER
+    than n and fill_diagonal cycles it, so node i receives compute-node
+    (i mod n_comp)'s delay.  The decision path then consumes this cycled
+    diagonal for local costs and server processing delays
+    (`forward_env` -> `np.diagonal` -> `offloading`,
+    `offloading_v3.py:396,406,411`), while the TF tensor (gradients only)
+    scatters correctly.  Our default path is the correct scatter; this
+    helper reproduces the bug so the published numbers can be matched in a
+    controlled experiment (PARITY.md).
+    """
+    n = inst.num_pad_nodes
+    # compute-capable node ids, ascending, padded nodes last
+    comp_idx = jnp.argsort(~inst.comp_mask, stable=True)
+    ncomp = jnp.maximum(jnp.sum(inst.comp_mask), 1)
+    cyc = comp_idx[jnp.arange(n) % ncomp]
+    return node_delay[cyc]
+
+
 def actor_delay_matrix(
     model,
     variables,

@@ -11,7 +11,11 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from multihop_offload_tpu.agent.actor import ActorOutput, actor_delay_matrix
+from multihop_offload_tpu.agent.actor import (
+    ActorOutput,
+    actor_delay_matrix,
+    compat_cycled_diagonal,
+)
 from multihop_offload_tpu.env.policies import PolicyOutcome, evaluate_spmatrix_policy
 from multihop_offload_tpu.graphs.instance import Instance, JobSet
 
@@ -26,11 +30,18 @@ def forward_env(
     explore=0.0,
     prob: bool = False,
     apsp_fn=None,
+    compat_diagonal_bug: bool = False,
 ) -> tuple[PolicyOutcome, ActorOutput]:
+    """`compat_diagonal_bug=True` feeds the decision path the reference's
+    cycled node-delay diagonal (`compat_cycled_diagonal`) instead of the
+    correct scatter — the A/B switch for matching its published numbers."""
     if support is None:
         support = inst.adj_ext  # reference compat: raw ext adjacency
     actor = actor_delay_matrix(model, variables, inst, jobs, support)
-    unit_diag = jnp.diagonal(actor.delay_matrix)
+    if compat_diagonal_bug:
+        unit_diag = compat_cycled_diagonal(inst, actor.node_delay)
+    else:
+        unit_diag = jnp.diagonal(actor.delay_matrix)
     outcome = evaluate_spmatrix_policy(
         inst, jobs, actor.link_delay, unit_diag, key,
         explore=explore, prob=prob, apsp_fn=apsp_fn,

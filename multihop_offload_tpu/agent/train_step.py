@@ -34,6 +34,7 @@ from jax import lax
 from multihop_offload_tpu.agent.actor import (
     ActorOutput,
     actor_delay_matrix,
+    compat_cycled_diagonal,
     lambdas_to_delay_matrix,
 )
 from multihop_offload_tpu.env.apsp import (
@@ -170,6 +171,7 @@ def forward_backward(
     critic_weight: float = 1.0,
     apsp_fn=None,
     dropout_rng: jax.Array | None = None,
+    compat_diagonal_bug: bool = False,
 ) -> TrainStepOutput:
     if support is None:
         support = inst.adj_ext
@@ -189,8 +191,16 @@ def forward_backward(
     dmtx, vjp_fn, actor = jax.vjp(actor_fn, variables, has_aux=True)
 
     # --- 2. env decision path on stopped values -------------------------
+    # (`compat_diagonal_bug` feeds the decision path the reference's cycled
+    # diagonal — same A/B switch as `forward_env`; gradients are unaffected,
+    # matching the reference where only the NumPy/decision copy is buggy)
     link_delay = lax.stop_gradient(actor.link_delay)
-    unit_diag = lax.stop_gradient(jnp.diagonal(dmtx))
+    if compat_diagonal_bug:
+        unit_diag = lax.stop_gradient(
+            compat_cycled_diagonal(inst, actor.node_delay)
+        )
+    else:
+        unit_diag = lax.stop_gradient(jnp.diagonal(dmtx))
     w = weight_matrix_from_link_delays(inst.adj, inst.link_index, link_delay)
     sp = apsp(w)
     hop = apsp(

@@ -34,6 +34,11 @@ class Config:
     epsilon_decay: float = 0.985   # for parity; see SURVEY.md §8)
     gamma: float = 1.0             # unused by the reference; kept for parity
     batch: int = 100               # replay minibatch (number of stored grads)
+    critic_weight: float = 1.0     # scale of the analytic-critic policy-
+    #                                sensitivity term (1.0 = reference math;
+    #                                0.0 trains on MSE supervision alone)
+    mse_weight: float = 0.001      # scale of the MSE pull toward empirical
+    #                                unit delays (`gnn_offloading_agent.py:443`)
 
     # ---- reference driver-level constants (AdHoc_train.py) -----------------
     num_instances: int = 10        # job-placement instances per network
@@ -56,6 +61,9 @@ class Config:
 
     # ---- TPU-native knobs -------------------------------------------------
     dtype: str = "float32"         # computation dtype ("float64" for parity)
+    compat_diagonal_bug: bool = False  # reproduce the reference's cycled
+    #                                decision-path diagonal (A/B validation;
+    #                                see agent.actor.compat_cycled_diagonal)
     instance_batch: int = 16       # vmap width (instances per device)
     pad_nodes: Optional[int] = None    # None = derive from data (next multiple)
     pad_links: Optional[int] = None

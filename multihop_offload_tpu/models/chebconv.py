@@ -147,27 +147,37 @@ def chebyshev_support(
     return (2.0 / lmax_val) * lap - eye
 
 
-def ensure_alive_output(model, variables, feats, support):
+def ensure_alive_output(model, variables, feats, support, mask=None):
     """Data-dependent init fixup for the dead-relu-at-birth pathology.
 
     The stack's single relu output unit sees pre-activations dominated by
     the (unnormalized, reference-faithful) link-rate feature, so across
     nodes they share one sign — a fresh init is all-alive or all-dead by a
     coin flip (measured ~half of seeds; a dead output has exactly-zero
-    gradients and can never train).  If the probe emits zero everywhere,
-    negate the final layer's kernel and bias: glorot is sign-symmetric, so
-    the flipped init is drawn from the same distribution, with positive
-    pre-activations.  Imported checkpoints never pass through here.
+    gradients and can never train).  If the probe emits zero on every VALID
+    slot, negate the final layer's kernel and bias: glorot is sign-
+    symmetric, so the flipped init is drawn from the same distribution,
+    with positive pre-activations.  Imported checkpoints never pass here.
+
+    `mask`: (E,) validity of each probe row.  REQUIRED with padded
+    features — padded slots see all-zero features, so their output is
+    relu(out-bias) > 0 and an unmasked `.any()` is trivially, wrongly true
+    (exactly the failure that let a dead init train for 2000 steps with
+    all-zero gradients).
     """
-    lam = model.apply(variables, feats, support)
-    if bool((lam > 0).any()):
+    valid = jnp.ones(feats.shape[0], bool) if mask is None else mask
+
+    def alive(vs) -> bool:
+        lam = model.apply(vs, feats, support)[:, 0]
+        return bool(((lam > 0) & valid).any())
+
+    if alive(variables):
         return variables
     params = dict(variables["params"])
     last = f"cheb_{model.num_layer - 1}"
     params[last] = jax.tree_util.tree_map(lambda w: -w, params[last])
     fixed = {**variables, "params": params}
-    lam = model.apply(fixed, feats, support)
-    if not bool((lam > 0).any()):  # pragma: no cover - both signs dead
+    if not alive(fixed):  # pragma: no cover - both signs dead
         raise RuntimeError("output unit dead under both kernel signs")
     return fixed
 

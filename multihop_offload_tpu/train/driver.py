@@ -105,6 +105,7 @@ class _Harness:
             self.variables = ensure_alive_output(
                 self.model, self.variables,
                 build_ext_features(inst0, jb0), inst0.adj_ext,
+                mask=inst0.ext_mask,
             )
         self.optimizer = make_optimizer(cfg)
         self.opt_state = self.optimizer.init(self.variables["params"])
@@ -124,6 +125,9 @@ class _Harness:
         prob = self.cfg.prob  # softmax-sample decisions (reference FLAGS.prob)
         use_dropout = self.cfg.dropout > 0
 
+        critic_w = self.cfg.critic_weight
+        mse_w = self.cfg.mse_weight
+
         def gnn_train_step(variables, mem, inst, jobsets, keys, explore):
             """vmapped forward_backward + in-program gradient memorization."""
 
@@ -132,7 +136,10 @@ class _Harness:
                 dk = jax.random.fold_in(k, 1) if use_dropout else None
                 return forward_backward(model, variables, inst, jb, k,
                                         explore=explore, prob=prob,
-                                        dropout_rng=dk)
+                                        dropout_rng=dk,
+                                        critic_weight=critic_w,
+                                        mse_weight=mse_w,
+                                        compat_diagonal_bug=compat_diag)
 
             outs = jax.vmap(one, in_axes=(0, 0))(jobsets, keys)
 
@@ -143,6 +150,8 @@ class _Harness:
             mem, _ = jax.lax.scan(remember, mem, jnp.arange(keys.shape[0]))
             return mem, outs.delays.job_total, outs.loss_critic, outs.loss_mse
 
+        compat_diag = self.cfg.compat_diagonal_bug
+
         def eval_methods(variables, inst, jobsets, keys):
             """baseline / local / GNN(explore=0) job totals, vmapped."""
             bl = jax.vmap(lambda jb, k: baseline_policy(inst, jb, k).job_total)(
@@ -150,8 +159,10 @@ class _Harness:
             )
             loc = jax.vmap(lambda jb: local_policy(inst, jb).job_total)(jobsets)
             gnn = jax.vmap(
-                lambda jb, k: forward_env(model, variables, inst, jb, k,
-                                          prob=prob)[0].job_total
+                lambda jb, k: forward_env(
+                    model, variables, inst, jb, k, prob=prob,
+                    compat_diagonal_bug=compat_diag,
+                )[0].job_total
             )(jobsets, keys)
             return bl, loc, gnn
 

@@ -66,6 +66,10 @@ def main() -> int:
     ap.add_argument("--scale", type=float, default=0.15,
                     help="arrival load scale; the reference shipped runs at "
                          "0.15 and 0.20")
+    ap.add_argument("--compat_diagonal_bug", action="store_true",
+                    help="reproduce the reference's cycled decision-path "
+                         "diagonal (A/B: should land within noise of its "
+                         "published GNN tau)")
     args = ap.parse_args()
     ref_csv = os.path.join(
         REF, "out",
@@ -84,6 +88,7 @@ def main() -> int:
         model_root=REF_MODEL_ROOT,
         dtype=args.dtype,
         seed=7,
+        compat_diagonal_bug=args.compat_diagonal_bug,
     )
     ev = Evaluator(cfg)
     csv_path = ev.run(files_limit=args.files, verbose=True)
@@ -96,7 +101,8 @@ def main() -> int:
     ours_agg = aggregates(ours, "Algo")
     ref_agg = aggregates(ref, "Algo")
 
-    report = {"ours_csv": csv_path, "reference_csv": ref_csv, "methods": {}}
+    report = {"ours_csv": csv_path, "reference_csv": ref_csv,
+              "compat_diagonal_bug": args.compat_diagonal_bug, "methods": {}}
     print(f"\n{'method':<10} {'metric':<24} {'reference':>12} {'ours':>12} {'rel diff':>9}")
     for algo in ALGO_MAP:
         r, o = ref_agg.get(algo, {}), ours_agg.get(algo, {})
@@ -111,7 +117,10 @@ def main() -> int:
         repo_validation = os.path.join(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))), "validation")
         record = repo_validation if os.path.isdir(repo_validation) else args.out
-    path = os.path.join(record, f"validation_vs_reference_load_{args.scale:.2f}.json")
+    suffix = "_compat" if args.compat_diagonal_bug else ""
+    path = os.path.join(
+        record, f"validation_vs_reference_load_{args.scale:.2f}{suffix}.json"
+    )
     os.makedirs(record, exist_ok=True)
     with open(path, "w") as f:
         json.dump(report, f, indent=2)

@@ -298,3 +298,38 @@ def test_dropout_active_in_training_path(setup):
     assert not np.allclose(np.asarray(g_det), np.asarray(g_a))
     assert not np.allclose(np.asarray(g_a), np.asarray(g_b))
     assert np.isfinite(np.asarray(g_a)).all()
+
+
+def test_compat_cycled_diagonal_matches_fill_diagonal(setup):
+    """compat mode must reproduce np.fill_diagonal's cycling of the shorter
+    compute-node delay vector (`gnn_offloading_agent.py:269` + decision-path
+    consumption at `offloading_v3.py:396`)."""
+    from multihop_offload_tpu.agent.actor import (
+        actor_delay_matrix, compat_cycled_diagonal,
+    )
+
+    rec, ca, inst, js, jobs_list, model, variables, pad = setup
+    actor = actor_delay_matrix(model, variables, inst, js, inst.adj_ext)
+    got = np.asarray(compat_cycled_diagonal(inst, actor.node_delay))
+
+    # numpy emulation on the real (unpadded) case
+    n = rec.topo.n
+    comp_nodes = np.flatnonzero(np.asarray(inst.comp_mask))
+    node_delay_comp = np.asarray(actor.node_delay)[comp_nodes]
+    emul = np.zeros((n, n))
+    np.fill_diagonal(emul, node_delay_comp)  # cycles when shorter
+    np.testing.assert_allclose(got[:n], np.diagonal(emul)[:n], rtol=1e-12)
+
+    # the cycled diagonal must actually differ from the correct one on a
+    # case with relays (else the A/B switch is a no-op)
+    assert rec.num_relays > 0
+    correct = np.asarray(jnp.diagonal(actor.delay_matrix))
+    assert not np.allclose(got[:n], correct[:n])
+    # and both A/B paths evaluate end-to-end with finite masked totals
+    from multihop_offload_tpu.agent import forward_env
+    out_fix, _ = forward_env(model, variables, inst, js, jax.random.PRNGKey(0))
+    out_bug, _ = forward_env(model, variables, inst, js, jax.random.PRNGKey(0),
+                             compat_diagonal_bug=True)
+    m = np.asarray(js.mask)
+    assert np.isfinite(np.asarray(out_bug.delays.job_total))[m].all()
+    assert np.isfinite(np.asarray(out_fix.delays.job_total))[m].all()

@@ -153,3 +153,38 @@ def test_ensure_alive_output_revives_dead_init():
                 np.asarray(fixed["params"]["cheb_4"]["kernel"]),
             )
     assert revived >= 2  # the pathology is common enough to matter
+
+
+def test_ensure_alive_output_not_fooled_by_padded_slots():
+    """Padded slots have all-zero features so their output is
+    relu(out_bias) > 0; the probe must ignore them or a dead init slips
+    through (observed: 2000 file-steps of training with all-zero grads)."""
+    import jax
+    import jax.numpy as jnp
+    from multihop_offload_tpu.models import ChebNet
+    from multihop_offload_tpu.models.chebconv import ensure_alive_output
+
+    rng = np.random.default_rng(0)
+    e, real = 64, 40
+    feats = np.zeros((e, 4), np.float32)
+    feats[:real, 0] = rng.integers(0, 2, real)
+    feats[:real, 1] = rng.uniform(20, 100, real)
+    feats[:real, 2] = rng.uniform(0, 8, real)
+    feats[:real, 3] = rng.integers(0, 2, real)
+    feats = jnp.asarray(feats)
+    sup = jnp.zeros((e, e), jnp.float32)
+    mask = jnp.arange(e) < real
+    model = ChebNet(param_dtype=jnp.float32)
+    flipped = 0
+    for seed in range(8):
+        vs = model.init(jax.random.PRNGKey(seed), feats, sup)
+        lam = model.apply(vs, feats, sup)[:, 0]
+        dead_real = not bool(((lam > 0) & mask).any())
+        fixed = ensure_alive_output(model, vs, feats, sup, mask=mask)
+        lam2 = model.apply(fixed, feats, sup)[:, 0]
+        assert bool(((lam2 > 0) & mask).any()), f"seed {seed} still dead"
+        if dead_real:
+            # unmasked probe would NOT have flipped (padded slots alive)
+            assert bool((lam > 0).any())
+            flipped += 1
+    assert flipped >= 2
