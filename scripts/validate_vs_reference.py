@@ -70,6 +70,12 @@ def main() -> int:
                     help="reproduce the reference's cycled decision-path "
                          "diagonal (A/B: should land within noise of its "
                          "published GNN tau)")
+    ap.add_argument("--model_root", default=REF_MODEL_ROOT,
+                    help="checkpoint root (default: the reference's shipped "
+                         "models; point at 'model' to evaluate our own)")
+    ap.add_argument("--training_set", default="BAT800",
+                    help="checkpoint directory tag, e.g. SCRATCH800 for the "
+                         "framework-trained model (restored via orbax)")
     args = ap.parse_args()
     ref_csv = os.path.join(
         REF, "out",
@@ -84,13 +90,16 @@ def main() -> int:
         out=args.out,
         T=1000,
         arrival_scale=args.scale,
-        training_set="BAT800",
-        model_root=REF_MODEL_ROOT,
+        training_set=args.training_set,
+        model_root=args.model_root,
         dtype=args.dtype,
         seed=7,
         compat_diagonal_bug=args.compat_diagonal_bug,
     )
     ev = Evaluator(cfg)
+    restored = ev.try_restore()
+    if restored is not None:
+        print(f"restored orbax step {restored} from {cfg.model_dir()}")
     csv_path = ev.run(files_limit=args.files, verbose=True)
 
     ours = pd.read_csv(csv_path)
@@ -118,6 +127,8 @@ def main() -> int:
             os.path.abspath(__file__))), "validation")
         record = repo_validation if os.path.isdir(repo_validation) else args.out
     suffix = "_compat" if args.compat_diagonal_bug else ""
+    if args.training_set != "BAT800":
+        suffix += f"_{args.training_set}"
     path = os.path.join(
         record, f"validation_vs_reference_load_{args.scale:.2f}{suffix}.json"
     )
