@@ -51,21 +51,41 @@ def replay_init(params: Any, capacity: int) -> GradReplay:
     )
 
 
-def replay_remember(mem: GradReplay, grads: Any, loss_critic, loss_mse) -> GradReplay:
-    """Ring-buffer append (deque(maxlen=capacity) semantics)."""
+def replay_remember(
+    mem: GradReplay, grads: Any, loss_critic, loss_mse, valid=None
+) -> GradReplay:
+    """Ring-buffer append (deque(maxlen=capacity) semantics).
+
+    `valid` (optional traced bool) makes the append a no-op when False —
+    used by the data-parallel drivers, which pad the per-file episode batch
+    up to a device-divisible width and must not memorize the pad episodes.
+    Only the addressed slot is touched either way (no full-buffer select).
+    """
     capacity = mem.loss_critic.shape[0]
     i = mem.ptr
-    new_grads = jax.tree_util.tree_map(
-        lambda buf, g: lax.dynamic_update_index_in_dim(buf, g.astype(buf.dtype), i, 0),
-        mem.grads,
-        grads,
-    )
+    if valid is None:
+        v = jnp.asarray(True)
+    else:
+        v = jnp.asarray(valid, bool)
+    step = v.astype(jnp.int32)
+
+    def upd(buf, g):
+        cur = lax.dynamic_index_in_dim(buf, i, 0, keepdims=False)
+        return lax.dynamic_update_index_in_dim(
+            buf, jnp.where(v, g.astype(buf.dtype), cur), i, 0
+        )
+
+    new_grads = jax.tree_util.tree_map(upd, mem.grads, grads)
+    lc = jnp.where(v, jnp.asarray(loss_critic, mem.loss_critic.dtype),
+                   mem.loss_critic[i])
+    lm = jnp.where(v, jnp.asarray(loss_mse, mem.loss_mse.dtype),
+                   mem.loss_mse[i])
     return GradReplay(
         grads=new_grads,
-        loss_critic=mem.loss_critic.at[i].set(jnp.asarray(loss_critic, mem.loss_critic.dtype)),
-        loss_mse=mem.loss_mse.at[i].set(jnp.asarray(loss_mse, mem.loss_mse.dtype)),
-        count=jnp.minimum(mem.count + 1, capacity),
-        ptr=(mem.ptr + 1) % capacity,
+        loss_critic=mem.loss_critic.at[i].set(lc),
+        loss_mse=mem.loss_mse.at[i].set(lm),
+        count=jnp.minimum(mem.count + step, capacity),
+        ptr=(mem.ptr + step) % capacity,
     )
 
 

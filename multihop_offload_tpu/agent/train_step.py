@@ -39,7 +39,6 @@ from multihop_offload_tpu.agent.actor import (
 )
 from multihop_offload_tpu.env.apsp import (
     apsp_minplus,
-    hop_matrix,
     next_hop_table,
     weight_matrix_from_link_delays,
 )
@@ -203,10 +202,9 @@ def forward_backward(
         unit_diag = lax.stop_gradient(jnp.diagonal(dmtx))
     w = weight_matrix_from_link_delays(inst.adj, inst.link_index, link_delay)
     sp = apsp(w)
-    hop = apsp(
-        jnp.where(inst.adj > 0, jnp.ones_like(inst.adj), jnp.full_like(inst.adj, jnp.inf))
-    )
-    dec = offload_decide(inst, jobs, sp, hop, unit_diag, key, explore, prob)
+    # hop counts are topology-only and precomputed at Instance build time
+    # (the reference recomputes Dijkstra hops per call, `:304-305`)
+    dec = offload_decide(inst, jobs, sp, inst.hop, unit_diag, key, explore, prob)
     routes = trace_routes(inst, next_hop_table(inst.adj, sp), jobs, dec.dst)
     delays = run_empirical(inst, jobs, routes)
 

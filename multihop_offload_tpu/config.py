@@ -61,6 +61,10 @@ class Config:
 
     # ---- TPU-native knobs -------------------------------------------------
     dtype: str = "float32"         # computation dtype ("float64" for parity)
+    apsp_impl: str = "xla"         # all-pairs-shortest-path kernel for the
+    #                                decision paths: xla | pallas | auto
+    #                                (ops.minplus.resolve_apsp; pallas falls
+    #                                back to XLA off-TPU or beyond size caps)
     compat_diagonal_bug: bool = False  # reproduce the reference's cycled
     #                                decision-path diagonal (A/B validation;
     #                                see agent.actor.compat_cycled_diagonal)
@@ -75,7 +79,11 @@ class Config:
     #                                compiles once at its own pad shape
     #                                (1 = single global shape)
     seed: int = 0                  # workload RNG (reference is unseeded)
-    mesh_data: int = 1             # data-parallel mesh axis size
+    mesh_data: int = 0             # data-parallel mesh axis size: 0 = auto
+    #                                (all local devices — Trainer/Evaluator
+    #                                shard episodes/files when >1 chip is
+    #                                present), 1 = force single-device, N =
+    #                                explicit axis size
     mesh_graph: int = 1            # graph-partition (ring APSP) axis size
     model_root: str = "model"      # parent dir of checkpoint directories
     tb_logdir: str = ""            # TensorBoard scalars ("" = disabled); the

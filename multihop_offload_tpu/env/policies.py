@@ -16,7 +16,6 @@ from flax import struct
 from multihop_offload_tpu.graphs.instance import Instance, JobSet
 from multihop_offload_tpu.env.apsp import (
     apsp_minplus,
-    hop_matrix,
     next_hop_table,
     weight_matrix_from_link_delays,
 )
@@ -59,10 +58,8 @@ def evaluate_spmatrix_policy(
     apsp = apsp_fn or apsp_minplus
     w = weight_matrix_from_link_delays(inst.adj, inst.link_index, link_delays)
     sp = apsp(w)
-    hop = apsp(
-        jnp.where(inst.adj > 0, jnp.ones_like(inst.adj), jnp.full_like(inst.adj, jnp.inf))
-    )
-    dec = offload_decide(inst, jobs, sp, hop, unit_diag, key, explore, prob)
+    # hop counts are topology-only and precomputed at Instance build time
+    dec = offload_decide(inst, jobs, sp, inst.hop, unit_diag, key, explore, prob)
     nh = next_hop_table(inst.adj, sp)
     routes = trace_routes(inst, nh, jobs, dec.dst)
     delays = run_empirical(inst, jobs, routes)
@@ -70,11 +67,14 @@ def evaluate_spmatrix_policy(
 
 
 def baseline_policy(
-    inst: Instance, jobs: JobSet, key: jax.Array, explore=0.0, prob: bool = False
+    inst: Instance, jobs: JobSet, key: jax.Array, explore=0.0, prob: bool = False,
+    apsp_fn=None,
 ) -> PolicyOutcome:
     """Congestion-agnostic greedy offloading (`AdHoc_train.py:128-141`)."""
     link_d, node_d = baseline_unit_delays(inst)
-    return evaluate_spmatrix_policy(inst, jobs, link_d, node_d, key, explore, prob)
+    return evaluate_spmatrix_policy(
+        inst, jobs, link_d, node_d, key, explore, prob, apsp_fn=apsp_fn
+    )
 
 
 def local_policy(inst: Instance, jobs: JobSet) -> PolicyOutcome:

@@ -78,6 +78,12 @@ class Instance:
     # servers, ascending node index (reference add-order, AdHoc_train.py:104-110)
     servers: np.ndarray      # (S,) int32 (pad = 0)
     server_mask: np.ndarray  # (S,) bool
+    # precomputed unweighted APSP (reference `sp_hop`, AdHoc_train.py:135).
+    # Hop counts depend only on the topology, so they are computed ONCE on
+    # host at build time instead of re-running a min-plus APSP inside every
+    # train/eval step (the reference recomputes Dijkstra hops per call,
+    # `gnn_offloading_agent.py:304-305` — we beat that, not copy it).
+    hop: np.ndarray          # (N, N) float hop counts (inf unreachable, 0 diag)
     # scalars
     T: np.ndarray            # () float congestion-penalty scale
 
@@ -114,8 +120,14 @@ def build_instance(
     t_max: float,
     pad: PadSpec,
     dtype=np.float32,
+    hop: Optional[np.ndarray] = None,
 ) -> Instance:
-    """Freeze a topology + resource assignment into a padded Instance."""
+    """Freeze a topology + resource assignment into a padded Instance.
+
+    `hop` optionally supplies the padded (pad.n, pad.n) hop-count matrix —
+    it depends only on the topology, so repeat builds of the same case
+    (per-visit link-rate re-realization) can cache it (`compute_hop_matrix`).
+    """
     n, l = topo.n, topo.num_links
     N, L, S = pad.n, pad.l, pad.s
     if n > N or l > L:
@@ -166,6 +178,10 @@ def build_instance(
     adj_ext[:L, L:] = inc
     adj_ext[L:, :L] = inc.T
 
+    if hop is None:
+        hop = compute_hop_matrix(topo, N)
+    hop = np.asarray(hop, dtype=dtype)
+
     server_ids = np.flatnonzero(roles_p == 1)
     if server_ids.size > S:
         raise ValueError(f"{server_ids.size} servers exceed pad {S}")
@@ -181,9 +197,24 @@ def build_instance(
         cf_degs=cf_degs, adj_ext=adj_ext, ext_rate=ext_rate,
         ext_self_loop=ext_self_loop, ext_as_server=ext_as_server,
         ext_mask=ext_mask, servers=servers, server_mask=server_mask,
-        T=np.asarray(t_max, dtype=dtype),
+        hop=hop, T=np.asarray(t_max, dtype=dtype),
     )
     return to_device(inst)
+
+
+def compute_hop_matrix(topo: Topology, pad_n: int) -> np.ndarray:
+    """Unweighted hop counts on host (scipy BFS), padded to (pad_n, pad_n):
+    pad nodes are unreachable (inf) with a zero diagonal — identical to
+    `env.apsp.hop_matrix(adj)` on the padded adjacency."""
+    from scipy.sparse import csr_matrix
+    from scipy.sparse.csgraph import shortest_path
+
+    hop = np.full((pad_n, pad_n), np.inf)
+    np.fill_diagonal(hop, 0.0)
+    hop[: topo.n, : topo.n] = shortest_path(
+        csr_matrix(topo.adj > 0), unweighted=True
+    )
+    return hop
 
 
 def build_jobset(

@@ -1,6 +1,7 @@
 from multihop_offload_tpu.ops.minplus import (  # noqa: F401
     apsp_minplus_pallas,
     minplus_power_kernel_call,
+    resolve_apsp,
 )
 from multihop_offload_tpu.ops.fixed_point import fixed_point_pallas  # noqa: F401
 from multihop_offload_tpu.ops.sparse import (  # noqa: F401

@@ -258,6 +258,31 @@ def pallas_apsp_path(n: int, interpret: bool = False) -> str:
     return "xla-fallback"
 
 
+def resolve_apsp(impl: str, n: int, interpret: bool = False):
+    """Resolve the config knob `apsp_impl` to an APSP callable.
+
+    Returns ``(apsp_fn, path)`` where ``apsp_fn`` is None for the default XLA
+    min-plus squaring (callers treat None as `env.apsp.apsp_minplus`) or
+    `apsp_minplus_pallas`, and ``path`` names what will actually execute:
+    'xla' | 'squaring' | 'blocked-fw'.  ``impl``:
+
+    * 'xla'    — always the XLA squaring;
+    * 'pallas' — the Pallas kernel whenever it can lower for this size/backend
+      (falls back to XLA otherwise, reported as 'xla');
+    * 'auto'   — Pallas when available, XLA otherwise (same resolution as
+      'pallas' today; the name leaves room for a measured policy).
+    """
+    if impl not in ("xla", "pallas", "auto"):
+        raise ValueError(f"apsp_impl must be xla|pallas|auto, got '{impl}'")
+    if impl == "xla":
+        return None, "xla"
+    path = pallas_apsp_path(n, interpret=interpret)
+    if path == "xla-fallback":
+        return None, "xla"
+    fn = functools.partial(apsp_minplus_pallas, interpret=interpret)
+    return fn, path
+
+
 def apsp_minplus_pallas(
     weights: jnp.ndarray,
     num_iters: int | None = None,

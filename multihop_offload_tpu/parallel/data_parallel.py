@@ -42,23 +42,81 @@ def _graph_apsp_fn(mesh: Mesh):
     return None
 
 
+def _gather_and_remember(outs, mem, valid):
+    """all_gather every device's episode gradients/losses over 'data' and
+    append them to the (replicated) ring buffer — the reference's gradient-
+    replay semantics on a mesh.  `valid` (None or a global (B,) bool mask)
+    keeps pad episodes out of the buffer.  Returns (mem, totals, lc, lm),
+    each gathered to full batch width."""
+    gather = lambda x: lax.all_gather(x, "data", axis=0, tiled=True)
+    all_grads, lc, lm, totals = jax.tree_util.tree_map(
+        gather,
+        (outs.grads["params"], outs.loss_critic, outs.loss_mse,
+         outs.delays.job_total),
+    )
+
+    def remember(m, i):
+        g = jax.tree_util.tree_map(lambda x: x[i], all_grads)
+        v = None if valid is None else valid[i]
+        return replay_remember(m, g, lc[i], lm[i], valid=v), None
+
+    mem, _ = lax.scan(remember, mem, jnp.arange(lc.shape[0]))
+    return mem, totals, lc, lm
+
+
+def make_file_dp_train_step(model, mesh: Mesh, dropout: bool = False,
+                            **fb_kwargs):
+    """Replay-semantics training step for ONE file: the instance is
+    replicated, the per-file episode batch (jobsets, keys) shards over
+    'data'.  This is the Trainer's multi-chip path: callers pad the episode
+    batch to a device-divisible width and pass `valid` to keep pad episodes
+    out of the replay buffer.  `fb_kwargs` forward to `forward_backward`
+    (critic_weight, mse_weight, prob, apsp_fn, compat_diagonal_bug, ...).
+
+    Signature: step(variables, mem, inst, jobsets, keys, valid, explore)
+    -> (mem, job_totals, loss_critic, loss_mse), all at full batch width.
+    """
+    fb_kwargs.setdefault("apsp_fn", _graph_apsp_fn(mesh))
+
+    def step(variables, mem, inst, jobsets, keys, valid, explore):
+        def one(jb, k):
+            dk = jax.random.fold_in(k, 1) if dropout else None
+            return forward_backward(model, variables, inst, jb, k,
+                                    explore=explore, dropout_rng=dk,
+                                    **fb_kwargs)
+
+        outs = jax.vmap(one)(jobsets, keys)
+        return _gather_and_remember(outs, mem, valid)
+
+    return jax.jit(
+        shard_map(
+            step, mesh=mesh,
+            in_specs=(P(), P(), P(), P("data"), P("data"), P(), P()),
+            out_specs=(P(), P(), P(), P()),
+            check_vma=False,
+        ),
+        donate_argnums=(1,),
+    )
+
+
 def make_dp_train_step(model, optimizer, mesh: Mesh, mode: str = "mean",
-                       dropout: bool = False):
+                       dropout: bool = False, **fb_kwargs):
     """Batched episode step: (variables, opt_state|mem, insts, jobsets, keys,
     explore) with the episode batch sharded over 'data'.
 
     Batch axis length must be divisible by the data-axis size.  `dropout`
     mirrors the single-host Trainer's `cfg.dropout > 0` wiring (a per-episode
-    dropout stream folded from the episode key).
+    dropout stream folded from the episode key); `fb_kwargs` forward to
+    `forward_backward`.
     """
-    apsp_fn = _graph_apsp_fn(mesh)
+    fb_kwargs.setdefault("apsp_fn", _graph_apsp_fn(mesh))
 
     def per_device(variables, insts, jobsets, keys, explore):
         def one(i, jb, k):
             dk = jax.random.fold_in(k, 1) if dropout else None
             return forward_backward(
-                model, variables, i, jb, k, explore=explore, apsp_fn=apsp_fn,
-                dropout_rng=dk,
+                model, variables, i, jb, k, explore=explore, dropout_rng=dk,
+                **fb_kwargs,
             )
 
         outs = jax.vmap(one)(insts, jobsets, keys)
@@ -98,26 +156,8 @@ def make_dp_train_step(model, optimizer, mesh: Mesh, mode: str = "mean",
 
         def step(variables, mem, insts, jobsets, keys, explore):
             outs = per_device(variables, insts, jobsets, keys, explore)
-            # replicate every device's episode gradients into the ring buffer
-            all_grads = jax.tree_util.tree_map(
-                lambda g: lax.all_gather(g, "data", axis=0, tiled=True),
-                outs.grads["params"],
-            )
-            lc = lax.all_gather(outs.loss_critic, "data", axis=0, tiled=True)
-            lm = lax.all_gather(outs.loss_mse, "data", axis=0, tiled=True)
-
-            def remember(m, i):
-                g = jax.tree_util.tree_map(lambda x: x[i], all_grads)
-                return replay_remember(m, g, lc[i], lm[i]), None
-
-            mem, _ = lax.scan(remember, mem, jnp.arange(lc.shape[0]))
-            metrics = {
-                "loss_critic": lc,
-                "loss_mse": lm,
-                "job_total": lax.all_gather(
-                    outs.delays.job_total, "data", axis=0, tiled=True
-                ),
-            }
+            mem, totals, lc, lm = _gather_and_remember(outs, mem, None)
+            metrics = {"loss_critic": lc, "loss_mse": lm, "job_total": totals}
             return mem, metrics
 
         in_specs = (P(), P(), P("data"), P("data"), P("data"), P())

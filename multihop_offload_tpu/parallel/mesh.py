@@ -60,20 +60,38 @@ def init_distributed(
             process_id=process_id,
         )
         return jax.process_index()
-    # strong hints name a coordinator outright; weak hints merely suggest a
-    # scheduler/pod context that may not resolve to a cluster spec (e.g.
-    # axon hosts export TPU_WORKER_HOSTNAMES with no coordinator)
+    # strong hints name a coordinator outright; weak hints suggest a
+    # scheduler/pod context, but only count when they actually imply more
+    # than one process — axon hosts export TPU_WORKER_HOSTNAMES=localhost
+    # (one entry) on plain single-process runs, and a 1-task SLURM
+    # allocation is not a cluster either
     strong_hints = (
         "COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS",
         "MEGASCALE_COORDINATOR_ADDRESS",
     )
-    weak_hints = (
-        "SLURM_JOB_ID", "OMPI_COMM_WORLD_SIZE", "TPU_WORKER_HOSTNAMES",
-        "CLOUD_TPU_TASK_ID",
-    )
     has_strong = any(h in os.environ for h in strong_hints)
-    if not has_strong and not any(h in os.environ for h in weak_hints):
-        return 0  # genuinely single-process: no cluster context detected
+
+    def _weak_multiprocess() -> bool:
+        def as_int(name):
+            try:
+                return int(os.environ.get(name, ""))
+            except ValueError:
+                return 0
+
+        hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+        n_hosts = len([h for h in hosts.split(",") if h.strip()])
+        return (
+            n_hosts > 1
+            or as_int("OMPI_COMM_WORLD_SIZE") > 1
+            or ("SLURM_JOB_ID" in os.environ
+                and max(as_int("SLURM_NTASKS"), as_int("SLURM_NPROCS")) > 1)
+            # Cloud TPU pods export a task id; jax auto-detects the rest
+            # from TPU metadata, so its presence alone warrants an attempt
+            or "CLOUD_TPU_TASK_ID" in os.environ
+        )
+
+    if not has_strong and not _weak_multiprocess():
+        return 0  # genuinely single-process: no multi-process context
     try:
         jax.distributed.initialize()
     except ValueError:
@@ -81,7 +99,7 @@ def init_distributed(
             # auto-detection could not assemble a cluster spec from weak
             # hints alone — "no cluster", not a failed bring-up (no
             # exception-text parsing: ValueError is jax.distributed's
-            # incomplete-spec signal; RuntimeErrors still propagate below)
+            # incomplete-spec signal; RuntimeErrors still propagate)
             return 0
         raise  # a named coordinator that fails to resolve IS misconfiguration
     # real bring-up failures (RuntimeError: coordinator unreachable, RPC

@@ -61,6 +61,8 @@ class DatasetCache:
     #                           but a low-n bucket can be denser in links)
     pads: List[PadSpec]       # per-bucket, ascending node pad
     bucket_of: List[int]      # record index -> bucket index
+    # topology-only hop matrices, cached across per-visit instance() rebuilds
+    _hop_cache: dict = dataclasses.field(default_factory=dict, repr=False)
 
     @classmethod
     def load(cls, cfg: Config, datapath: Optional[str] = None) -> "DatasetCache":
@@ -93,14 +95,21 @@ class DatasetCache:
 
     def instance(self, idx: int, rng: np.random.Generator) -> Instance:
         """Freeze case `idx` with freshly realized link capacities
-        (`links_init` noise is re-drawn every visit, as in the reference)."""
+        (`links_init` noise is re-drawn every visit, as in the reference).
+        The topology-only hop matrix is cached across visits."""
         rec = self.records[idx]
+        from multihop_offload_tpu.graphs.instance import compute_hop_matrix
         from multihop_offload_tpu.graphs.topology import sample_link_rates
 
+        pad = self.pad_of(idx)
+        hop = self._hop_cache.get(idx)
+        if hop is None:
+            hop = compute_hop_matrix(rec.topo, pad.n)
+            self._hop_cache[idx] = hop
         rates = sample_link_rates(rec.topo, rec.link_rates, rng=rng)
         return build_instance(
             rec.topo, rec.roles, rec.proc_bws, rates,
-            float(self.cfg.T), self.pad_of(idx), dtype=self.cfg.jnp_dtype,
+            float(self.cfg.T), pad, dtype=self.cfg.jnp_dtype, hop=hop,
         )
 
 

@@ -110,8 +110,34 @@ class _Harness:
         self.optimizer = make_optimizer(cfg)
         self.opt_state = self.optimizer.init(self.variables["params"])
         # multi-host runs share a filesystem: only process 0 writes CSVs,
-        # checkpoints, and TB events (every process computes identically)
+        # checkpoints, and TB events
         self.is_host0 = jax.process_index() == 0
+        # data-parallel mesh (SURVEY.md §2.8): with >1 device the Trainer
+        # shards the per-file episode batch and the Evaluator shards files
+        # over the 'data' axis; mesh_data=0 means "all local devices" —
+        # local only: the drivers feed host-local arrays into shard_map, so
+        # a mesh spanning other processes' devices would be rejected (multi-
+        # host runs keep the every-process-computes-identically scheme)
+        local = jax.local_devices()
+        if cfg.mesh_data > len(local):
+            raise ValueError(
+                f"mesh_data={cfg.mesh_data} exceeds the {len(local)} local "
+                "devices — an explicit request is honored or refused, never "
+                "silently clamped"
+            )
+        if cfg.mesh_graph > 1:
+            raise ValueError(
+                "the Trainer/Evaluator drivers shard only the 'data' axis; "
+                "mesh_graph>1 applies to the library paths "
+                "(parallel.make_dp_train_step / parallel.ring)"
+            )
+        self.n_dp = max(1, cfg.mesh_data if cfg.mesh_data > 0 else len(local))
+        self.mesh = None
+        if self.n_dp > 1:
+            from multihop_offload_tpu.parallel.mesh import make_mesh
+
+            self.mesh = make_mesh(data=self.n_dp, graph=1,
+                                  devices=local[: self.n_dp])
         self.memory = None if memory_size == 0 else replay_init(
             self.variables["params"], memory_size or cfg.memory_size
         )
@@ -127,6 +153,12 @@ class _Harness:
 
         critic_w = self.cfg.critic_weight
         mse_w = self.cfg.mse_weight
+        # APSP kernel for the decision paths (`apsp_impl` knob): None -> the
+        # XLA min-plus squaring, else the Pallas kernel; `self.apsp_path`
+        # records what actually executes so entry points can report it
+        from multihop_offload_tpu.ops.minplus import resolve_apsp
+
+        apsp_fn, self.apsp_path = resolve_apsp(self.cfg.apsp_impl, self.data.pad.n)
 
         def gnn_train_step(variables, mem, inst, jobsets, keys, explore):
             """vmapped forward_backward + in-program gradient memorization."""
@@ -139,6 +171,7 @@ class _Harness:
                                         dropout_rng=dk,
                                         critic_weight=critic_w,
                                         mse_weight=mse_w,
+                                        apsp_fn=apsp_fn,
                                         compat_diagonal_bug=compat_diag)
 
             outs = jax.vmap(one, in_axes=(0, 0))(jobsets, keys)
@@ -153,14 +186,16 @@ class _Harness:
         compat_diag = self.cfg.compat_diagonal_bug
 
         def eval_methods(variables, inst, jobsets, keys):
-            """baseline / local / GNN(explore=0) job totals, vmapped."""
-            bl = jax.vmap(lambda jb, k: baseline_policy(inst, jb, k).job_total)(
-                jobsets, keys
-            )
+            """baseline / local / GNN(explore=0) job totals, vmapped.
+            The ONE definition of the method triple — every single-device
+            and sharded variant below wraps this same closure."""
+            bl = jax.vmap(
+                lambda jb, k: baseline_policy(inst, jb, k, apsp_fn=apsp_fn).job_total
+            )(jobsets, keys)
             loc = jax.vmap(lambda jb: local_policy(inst, jb).job_total)(jobsets)
             gnn = jax.vmap(
                 lambda jb, k: forward_env(
-                    model, variables, inst, jb, k, prob=prob,
+                    model, variables, inst, jb, k, prob=prob, apsp_fn=apsp_fn,
                     compat_diagonal_bug=compat_diag,
                 )[0].job_total
             )(jobsets, keys)
@@ -171,6 +206,61 @@ class _Harness:
         self._replay = jax.jit(
             partial(replay_apply, optimizer=self.optimizer,
                     batch=self.cfg.batch, max_norm=self.cfg.max_norm),
+        )
+        if self.n_dp > 1:
+            self._build_dp_steps(model, prob, use_dropout, critic_w, mse_w,
+                                 compat_diag, apsp_fn, eval_methods)
+
+    def _build_dp_steps(self, model, prob, use_dropout, critic_w, mse_w,
+                        compat_diag, apsp_fn, eval_methods):
+        """shard_map variants over the 'data' mesh axis (new capability vs the
+        single-device reference, SURVEY.md §2.8): the Trainer shards the
+        per-file episode batch, the Evaluator shards whole files.  Episode
+        batches are padded to a device-divisible width by the callers; the
+        `valid` mask keeps pad episodes out of the replay buffer."""
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from multihop_offload_tpu.parallel.data_parallel import (
+            make_file_dp_train_step,
+        )
+
+        mesh = self.mesh
+        gather = lambda x: jax.lax.all_gather(x, "data", axis=0, tiled=True)
+
+        self._gnn_train_step_dp = make_file_dp_train_step(
+            model, mesh, dropout=use_dropout, prob=prob,
+            critic_weight=critic_w, mse_weight=mse_w, apsp_fn=apsp_fn,
+            compat_diagonal_bug=compat_diag,
+        )
+
+        def eval_methods_sharded(variables, inst, jobsets, keys):
+            return jax.tree_util.tree_map(
+                gather, eval_methods(variables, inst, jobsets, keys)
+            )
+
+        def eval_files(variables, insts, jobsets, keys):
+            """One file per mesh slot: (D, ...) instances, (D, I, ...) jobsets."""
+            per_file = jax.vmap(
+                lambda i, jbs, ks: eval_methods(variables, i, jbs, ks)
+            )(insts, jobsets, keys)
+            return jax.tree_util.tree_map(gather, per_file)
+
+        self._eval_methods_dp = jax.jit(
+            shard_map(
+                eval_methods_sharded, mesh=mesh,
+                in_specs=(P(), P(), P("data"), P("data")),
+                out_specs=(P(), P(), P()),
+                check_vma=False,
+            )
+        )
+        self._eval_files_dp = jax.jit(
+            shard_map(
+                eval_files, mesh=mesh,
+                in_specs=(P(), P("data"), P("data"), P("data")),
+                out_specs=(P(), P(), P()),
+                check_vma=False,
+            )
         )
 
     def next_keys(self, n: int):
@@ -202,6 +292,20 @@ class _Harness:
         self.variables = {"params": restored["params"]}
         self.opt_state = restored["opt_state"]
         return step
+
+
+def _pad_leading(tree, size: int):
+    """Pad every leaf's leading axis up to `size` by repeating the last row."""
+    import jax.tree_util as jtu
+
+    def pad(x):
+        b = x.shape[0]
+        if b >= size:
+            return x
+        reps = jnp.broadcast_to(x[-1:], (size - b,) + x.shape[1:])
+        return jnp.concatenate([x, reps], axis=0)
+
+    return jtu.tree_map(pad, tree)
 
 
 def _rows(rec, counts, metrics_per_method, runtime, fid, ni_offset=0,
@@ -287,14 +391,35 @@ class Trainer(_Harness):
                     dtype=cfg.jnp_dtype,
                 )
                 t0 = time.time()
-                self.memory, gnn_totals, loss_c, loss_m = self._gnn_train_step(
-                    self.variables, self.memory, inst, jobsets,
-                    self.next_keys(cfg.num_instances),
-                    jnp.asarray(explore, cfg.jnp_dtype),
-                )
-                bl, loc, gnn_test = self._eval_methods(
-                    self.variables, inst, jobsets, self.next_keys(cfg.num_instances)
-                )
+                if self.n_dp > 1:
+                    # pad the episode batch to a device-divisible width; the
+                    # valid mask keeps pad episodes out of the replay buffer
+                    b = cfg.num_instances
+                    bp = -(-b // self.n_dp) * self.n_dp
+                    jobsets_p = _pad_leading(jobsets, bp)
+                    valid = jnp.arange(bp) < b
+                    self.memory, gnn_totals, loss_c, loss_m = self._gnn_train_step_dp(
+                        self.variables, self.memory, inst, jobsets_p,
+                        self.next_keys(bp), valid,
+                        jnp.asarray(explore, cfg.jnp_dtype),
+                    )
+                    bl, loc, gnn_test = self._eval_methods_dp(
+                        self.variables, inst, jobsets_p, self.next_keys(bp)
+                    )
+                    gnn_totals, loss_c, loss_m, bl, loc, gnn_test = (
+                        x[:b] for x in
+                        (gnn_totals, loss_c, loss_m, bl, loc, gnn_test)
+                    )
+                else:
+                    self.memory, gnn_totals, loss_c, loss_m = self._gnn_train_step(
+                        self.variables, self.memory, inst, jobsets,
+                        self.next_keys(cfg.num_instances),
+                        jnp.asarray(explore, cfg.jnp_dtype),
+                    )
+                    bl, loc, gnn_test = self._eval_methods(
+                        self.variables, inst, jobsets,
+                        self.next_keys(cfg.num_instances)
+                    )
                 jax.block_until_ready(gnn_test)
                 runtime = (time.time() - t0) / (4 * cfg.num_instances)
                 self.mem_count = min(
@@ -345,6 +470,12 @@ class Evaluator(_Harness):
     def __init__(self, cfg: Config, datapath: Optional[str] = None):
         super().__init__(cfg, datapath, memory_size=0)
 
+    def _file_rng(self, fid: int) -> np.random.Generator:
+        """Per-file workload RNG keyed by (seed, fid): the realized link
+        rates and jobsets are identical no matter how files are ordered or
+        sharded over devices (the file-DP path visits bucket-by-bucket)."""
+        return np.random.default_rng((self.cfg.seed, fid))
+
     def run(self, files_limit: Optional[int] = None, out_dir: Optional[str] = None,
             verbose: bool = True):
         cfg = self.cfg
@@ -355,31 +486,104 @@ class Evaluator(_Harness):
             out_dir,
             f"Adhoc_test_data_{dataset_tag}_load_{cfg.arrival_scale:.2f}_T_{cfg.T}.csv",
         )
-        rows = []
         n_files = min(len(self.data), files_limit or len(self.data))
-        for fid in range(n_files):
-            rec = self.data.records[fid]
-            inst = self.data.instance(fid, self.rng)
-            jobsets, counts = sample_jobsets(
-                rec, self.data.pad_of(fid), cfg.num_instances, self.rng,
-                cfg.arrival_scale, ul=cfg.ul_data, dl=cfg.dl_data,
-                dtype=cfg.jnp_dtype,
-            )
-            t0 = time.time()
-            bl, loc, gnn = self._eval_methods(
-                self.variables, inst, jobsets, self.next_keys(cfg.num_instances)
-            )
-            jax.block_until_ready(gnn)
-            runtime = (time.time() - t0) / (3 * cfg.num_instances)
-            metrics = _method_metrics(
-                {"baseline": bl, "local": loc, "GNN": gnn},
-                bl, jobsets.mask, float(cfg.T),
-            )
-            rows += _rows(rec, counts, metrics, runtime, fid,
-                          algo_col="Algo", fid_col=False)
-            if verbose and fid % 50 == 0:
-                print(f"[{fid + 1}/{n_files}] {rec.filename} "
-                      f"({(time.time() - t0):.3f}s for {3 * cfg.num_instances} evals)")
+
+        def flush(rows):
             if self.is_host0:
-                pd.DataFrame(rows, columns=TEST_COLUMNS).to_csv(csv_path, index=False)
+                pd.DataFrame(rows, columns=TEST_COLUMNS).to_csv(
+                    csv_path, index=False
+                )
+
+        if self.n_dp > 1:
+            self._run_files_dp(n_files, verbose, flush)
+        else:
+            rows = []
+            for fid in range(n_files):
+                rec = self.data.records[fid]
+                frng = self._file_rng(fid)
+                inst = self.data.instance(fid, frng)
+                jobsets, counts = sample_jobsets(
+                    rec, self.data.pad_of(fid), cfg.num_instances, frng,
+                    cfg.arrival_scale, ul=cfg.ul_data, dl=cfg.dl_data,
+                    dtype=cfg.jnp_dtype,
+                )
+                t0 = time.time()
+                bl, loc, gnn = self._eval_methods(
+                    self.variables, inst, jobsets, self.next_keys(cfg.num_instances)
+                )
+                jax.block_until_ready(gnn)
+                runtime = (time.time() - t0) / (3 * cfg.num_instances)
+                metrics = _method_metrics(
+                    {"baseline": bl, "local": loc, "GNN": gnn},
+                    bl, jobsets.mask, float(cfg.T),
+                )
+                rows += _rows(rec, counts, metrics, runtime, fid,
+                              algo_col="Algo", fid_col=False)
+                if verbose and fid % 50 == 0:
+                    print(f"[{fid + 1}/{n_files}] {rec.filename} "
+                          f"({(time.time() - t0):.3f}s for {3 * cfg.num_instances} evals)")
+                flush(rows)
         return csv_path
+
+    def _run_files_dp(self, n_files: int, verbose: bool, flush):
+        """Shard whole files over the 'data' mesh axis: each chunk stacks
+        `n_dp` same-bucket files (same pad shape) and evaluates them in one
+        sharded program.  The last chunk of a bucket pads by REUSING its
+        final file's instance/jobsets (no extra RNG draws — same seed must
+        mean same workloads as the single-device loop); pad rows are
+        dropped.  Rows are flushed incrementally in file order."""
+        cfg = self.cfg
+        from multihop_offload_tpu.graphs.instance import stack_instances
+
+        by_bucket = {}
+        for fid in range(n_files):
+            by_bucket.setdefault(self.data.bucket_of[fid], []).append(fid)
+        rows_by_fid = {}
+        done = 0
+        for bucket, fids in sorted(by_bucket.items()):
+            for c0 in range(0, len(fids), self.n_dp):
+                chunk = fids[c0: c0 + self.n_dp]
+                real = len(chunk)
+                insts, jsets, cnts = [], [], []
+                for fid in chunk:
+                    rec = self.data.records[fid]
+                    frng = self._file_rng(fid)
+                    insts.append(self.data.instance(fid, frng))
+                    js, counts = sample_jobsets(
+                        rec, self.data.pad_of(fid), cfg.num_instances, frng,
+                        cfg.arrival_scale, ul=cfg.ul_data, dl=cfg.dl_data,
+                        dtype=cfg.jnp_dtype,
+                    )
+                    jsets.append(js)
+                    cnts.append(counts)
+                for _ in range(self.n_dp - real):  # pad slots: no RNG draws
+                    insts.append(insts[-1])
+                    jsets.append(jsets[-1])
+                binst = stack_instances(insts)
+                bjobs = stack_instances(jsets)
+                keys = self.next_keys(self.n_dp * cfg.num_instances).reshape(
+                    self.n_dp, cfg.num_instances, -1
+                )
+                t0 = time.time()
+                bl, loc, gnn = self._eval_files_dp(
+                    self.variables, binst, bjobs, keys
+                )
+                jax.block_until_ready(gnn)
+                # normalize by the full chunk width: pad slots run in
+                # parallel, so per-eval cost is t/(3*I*n_dp) for every chunk
+                runtime = (time.time() - t0) / (3 * cfg.num_instances * self.n_dp)
+                for d in range(real):
+                    fid = chunk[d]
+                    metrics = _method_metrics(
+                        {"baseline": bl[d], "local": loc[d], "GNN": gnn[d]},
+                        bl[d], jsets[d].mask, float(cfg.T),
+                    )
+                    rows_by_fid[fid] = _rows(
+                        self.data.records[fid], cnts[d], metrics, runtime, fid,
+                        algo_col="Algo", fid_col=False,
+                    )
+                done += real
+                if verbose:
+                    print(f"[{done}/{n_files}] bucket {bucket} chunk of {real} "
+                          f"({(time.time() - t0):.3f}s on {self.n_dp} devices)")
+                flush([r for f in sorted(rows_by_fid) for r in rows_by_fid[f]])

@@ -147,3 +147,53 @@ def test_prob_mode_plumbed_through_evaluator(tmp_path, tiny_dataset, monkeypatch
     g0 = out[False][out[False].Algo == "GNN"]["tau"].to_numpy()
     g1 = out[True][out[True].Algo == "GNN"]["tau"].to_numpy()
     assert not np.allclose(g0, g1)  # softmax sampling changes decisions
+
+
+def test_dp_evaluator_matches_single_device(tmp_path, tiny_dataset, monkeypatch):
+    """File-sharded evaluation over the 8-device mesh must be bit-equal to
+    the single-device loop: same seed -> same workloads (keys are unused by
+    deterministic argmin decisions), so tau/congestion match exactly."""
+    monkeypatch.chdir(tmp_path)
+    cols = ["filename", "n_instance", "Algo", "tau", "congest_jobs"]
+    dfs = {}
+    for mesh_data, tag in ((1, "single"), (0, "auto")):
+        # pad_buckets=2: the DP path visits files bucket-by-bucket, the
+        # single-device loop in fid order — per-file RNG keying must make
+        # the workloads identical anyway
+        cfg = _cfg(tmp_path, tiny_dataset, mesh_data=mesh_data,
+                   pad_buckets=2, out=str(tmp_path / f"out_{tag}"))
+        ev = Evaluator(cfg)
+        assert ev.n_dp == (1 if mesh_data == 1 else 8)
+        dfs[tag] = pd.read_csv(ev.run(verbose=False)).sort_values(
+            ["filename", "Algo", "n_instance"]
+        )[cols].reset_index(drop=True)
+    pd.testing.assert_frame_equal(dfs["single"], dfs["auto"])
+
+
+def test_cli_train_dp_on_mesh(tmp_path, tiny_dataset, monkeypatch):
+    """`cli/train.py` end-to-end on the 8-virtual-device mesh: the Trainer
+    takes the data-parallel path (mesh_data auto), writes the training CSV,
+    and checkpoints restorably."""
+    from multihop_offload_tpu.cli import train as cli_train
+    from multihop_offload_tpu.config import from_args
+
+    monkeypatch.chdir(tmp_path)
+    argv = [
+        f"--datapath={tiny_dataset}", f"--out={tmp_path / 'out_cli'}",
+        f"--model_root={tmp_path / 'model_cli'}", "--epochs=1",
+        "--num_instances=4", "--batch=6", "--memory_size=32",
+        "--dtype=float64", "--seed=3", "--training_set=CLI",
+        "--learning_rate=1e-5",
+    ]
+    cli_train.main(argv)
+    csvs = list((tmp_path / "out_cli").glob("aco_training_data_*.csv"))
+    assert len(csvs) == 1
+    df = pd.read_csv(csvs[0])
+    assert list(df.columns) == TRAIN_COLUMNS
+    assert len(df) == 4 * 4 * 4  # files x instances x methods
+    assert np.isfinite(df["tau"]).all()
+    # one Trainer both proves the CLI config resolves to the DP path and
+    # restores the checkpoint the CLI run wrote
+    tr = Trainer(from_args(argv))
+    assert tr.n_dp == 8
+    assert tr.try_restore() == 0

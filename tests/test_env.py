@@ -63,6 +63,8 @@ def test_apsp_matches_dijkstra(small_cases, rng):
 
     hop = np.asarray(hop_matrix(inst.adj))
     np.testing.assert_allclose(hop[:n, :n], refenv.hop_oracle(ca["adj"]), rtol=0)
+    # the precomputed (host BFS) hop field must equal the device APSP result
+    np.testing.assert_allclose(np.asarray(inst.hop), hop, rtol=0)
 
 
 def test_next_hop_and_routes_match_oracle(small_cases, rng):
