@@ -261,26 +261,20 @@ def pallas_apsp_path(n: int, interpret: bool = False) -> str:
 def resolve_apsp(impl: str, n: int, interpret: bool = False):
     """Resolve the config knob `apsp_impl` to an APSP callable.
 
-    Returns ``(apsp_fn, path)`` where ``apsp_fn`` is None for the default XLA
-    min-plus squaring (callers treat None as `env.apsp.apsp_minplus`) or
-    `apsp_minplus_pallas`, and ``path`` names what will actually execute:
-    'xla' | 'squaring' | 'blocked-fw'.  ``impl``:
-
-    * 'xla'    — always the XLA squaring;
-    * 'pallas' — the Pallas kernel whenever it can lower for this size/backend
-      (falls back to XLA otherwise, reported as 'xla');
-    * 'auto'   — Pallas when available, XLA otherwise (same resolution as
-      'pallas' today; the name leaves room for a measured policy).
+    Returns ``(apsp_fn, path)``.  ``apsp_fn`` is None for the default XLA
+    min-plus squaring (callers treat None as `env.apsp.apsp_minplus`); for
+    'pallas'/'auto' it is `apsp_minplus_pallas`, which re-resolves PER CALL
+    SHAPE (squaring <= 256, blocked FW <= 2048, XLA beyond / off-TPU) — so
+    mixed-size bucketed datasets each get the right kernel.  ``path`` is the
+    resolution REPORT for size ``n`` ('xla' | 'squaring' | 'blocked-fw' |
+    'xla-fallback'); other bucket sizes may resolve differently.
     """
     if impl not in ("xla", "pallas", "auto"):
         raise ValueError(f"apsp_impl must be xla|pallas|auto, got '{impl}'")
     if impl == "xla":
         return None, "xla"
-    path = pallas_apsp_path(n, interpret=interpret)
-    if path == "xla-fallback":
-        return None, "xla"
     fn = functools.partial(apsp_minplus_pallas, interpret=interpret)
-    return fn, path
+    return fn, pallas_apsp_path(n, interpret=interpret)
 
 
 def apsp_minplus_pallas(

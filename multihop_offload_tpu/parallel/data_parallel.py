@@ -99,6 +99,52 @@ def make_file_dp_train_step(model, mesh: Mesh, dropout: bool = False,
     )
 
 
+def make_sharded_eval_step(eval_fn, mesh: Mesh):
+    """Shard a per-file eval closure's episode batch over 'data'.
+
+    `eval_fn(variables, inst, jobsets, keys)` must return a 3-tuple of
+    (B_local, ...) arrays (the drivers' baseline/local/GNN totals); the
+    returned step takes the full batch (jobsets/keys sharded, inst
+    replicated) and gathers every output to full width.
+    """
+    gather = lambda x: lax.all_gather(x, "data", axis=0, tiled=True)
+
+    def step(variables, inst, jobsets, keys):
+        return jax.tree_util.tree_map(
+            gather, eval_fn(variables, inst, jobsets, keys)
+        )
+
+    return jax.jit(
+        shard_map(
+            step, mesh=mesh,
+            in_specs=(P(), P(), P("data"), P("data")),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        )
+    )
+
+
+def make_files_eval_step(eval_fn, mesh: Mesh):
+    """Shard WHOLE files over 'data': one (instance, jobsets, keys) triple
+    per mesh slot, `eval_fn` applied per file, outputs gathered."""
+    gather = lambda x: lax.all_gather(x, "data", axis=0, tiled=True)
+
+    def step(variables, insts, jobsets, keys):
+        per_file = jax.vmap(
+            lambda i, jbs, ks: eval_fn(variables, i, jbs, ks)
+        )(insts, jobsets, keys)
+        return jax.tree_util.tree_map(gather, per_file)
+
+    return jax.jit(
+        shard_map(
+            step, mesh=mesh,
+            in_specs=(P(), P("data"), P("data"), P("data")),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        )
+    )
+
+
 def make_dp_train_step(model, optimizer, mesh: Mesh, mode: str = "mean",
                        dropout: bool = False, **fb_kwargs):
     """Batched episode step: (variables, opt_state|mem, insts, jobsets, keys,

@@ -218,50 +218,20 @@ class _Harness:
         per-file episode batch, the Evaluator shards whole files.  Episode
         batches are padded to a device-divisible width by the callers; the
         `valid` mask keeps pad episodes out of the replay buffer."""
-        from jax import shard_map
-        from jax.sharding import PartitionSpec as P
-
         from multihop_offload_tpu.parallel.data_parallel import (
             make_file_dp_train_step,
+            make_files_eval_step,
+            make_sharded_eval_step,
         )
 
         mesh = self.mesh
-        gather = lambda x: jax.lax.all_gather(x, "data", axis=0, tiled=True)
-
         self._gnn_train_step_dp = make_file_dp_train_step(
             model, mesh, dropout=use_dropout, prob=prob,
             critic_weight=critic_w, mse_weight=mse_w, apsp_fn=apsp_fn,
             compat_diagonal_bug=compat_diag,
         )
-
-        def eval_methods_sharded(variables, inst, jobsets, keys):
-            return jax.tree_util.tree_map(
-                gather, eval_methods(variables, inst, jobsets, keys)
-            )
-
-        def eval_files(variables, insts, jobsets, keys):
-            """One file per mesh slot: (D, ...) instances, (D, I, ...) jobsets."""
-            per_file = jax.vmap(
-                lambda i, jbs, ks: eval_methods(variables, i, jbs, ks)
-            )(insts, jobsets, keys)
-            return jax.tree_util.tree_map(gather, per_file)
-
-        self._eval_methods_dp = jax.jit(
-            shard_map(
-                eval_methods_sharded, mesh=mesh,
-                in_specs=(P(), P(), P("data"), P("data")),
-                out_specs=(P(), P(), P()),
-                check_vma=False,
-            )
-        )
-        self._eval_files_dp = jax.jit(
-            shard_map(
-                eval_files, mesh=mesh,
-                in_specs=(P(), P("data"), P("data"), P("data")),
-                out_specs=(P(), P(), P()),
-                check_vma=False,
-            )
-        )
+        self._eval_methods_dp = make_sharded_eval_step(eval_methods, mesh)
+        self._eval_files_dp = make_files_eval_step(eval_methods, mesh)
 
     def next_keys(self, n: int):
         self.key, *keys = jax.random.split(self.key, n + 1)
@@ -376,6 +346,9 @@ class Trainer(_Harness):
         rows = []
         explore = cfg.explore
         losses = []
+        self.replay_losses = []  # every replay update's mean sampled critic
+        #                          loss, in order (the number the reference
+        #                          prints per file, `AdHoc_train.py:194-202`)
         gidx = 0
         tb = ScalarLogger(cfg.tb_logdir if self.is_host0 else None)
         for epoch in range(epochs if epochs is not None else cfg.epochs):
@@ -442,6 +415,7 @@ class Trainer(_Harness):
                     )
                     self.variables = {"params": params}
                     loss = float(loss_dev)
+                    self.replay_losses.append(loss)
                 losses.append(loss)
 
                 if np.isfinite(loss):

@@ -1,6 +1,8 @@
 """Learning-dynamics smoke tests: the full actor/critic/replay loop moves the
 policy in the right direction, and the real (K>=2) spectral GNN trains too."""
 
+import os
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -156,3 +158,54 @@ def test_k2_spectral_gnn_trains(world):
     tau = _mean_tau(model, variables, binst, bjobs, jax.random.PRNGKey(4),
                     support_fn=lambda i: chebyshev_support(i.adj_ext, i.ext_mask))
     assert np.isfinite(tau)
+
+
+def test_midscale_training_improves_heldout_tau(tmp_path, monkeypatch):
+    """Mid-scale integration (round-2 verdict #7): ~20 generated networks,
+    3 epochs of the reference's critic recipe — replay updates must reduce
+    the replay (critic) loss AND the trained model must beat the fresh-init
+    model on held-out workloads (same seed -> identical workloads)."""
+    import pandas as pd
+
+    from multihop_offload_tpu.cli.datagen import generate_dataset
+    from multihop_offload_tpu.train.driver import Evaluator, Trainer
+
+    monkeypatch.chdir(tmp_path)
+    data = str(tmp_path / "aco_mid")
+    generate_dataset(data, gtype="ba", size=10, seed0=900,
+                     graph_sizes=[20, 30], verbose=False)
+    kw = dict(datapath=data, T=800, arrival_scale=0.15, dtype="float32",
+              num_instances=4, batch=20, memory_size=200, seed=5, mesh_data=1,
+              critic_weight=1.0, learning_rate=1e-4, epochs=3)
+
+    cfg = Config(out=str(tmp_path / "out"), model_root=str(tmp_path / "m_tr"),
+                 training_set="MID", **kw)
+    tr = Trainer(cfg)
+    tr.run(verbose=False)
+
+    # replay updates reduce the sampled critic loss.  The decline plateaus
+    # quickly (the critic loss is the analytic TOTAL delay, mostly
+    # irreducible once the policy is near-optimal), so assert on halves:
+    # calibration 249.2 -> 230.7 under the suite's x64 config
+    rl = tr.replay_losses
+    assert len(rl) >= 20
+    half = len(rl) // 2
+    assert np.mean(rl[half:]) < 0.97 * np.mean(rl[:half]), (
+        f"replay loss did not decline: first half {np.mean(rl[:half]):.1f} "
+        f"last half {np.mean(rl[half:]):.1f}"
+    )
+
+    # held-out comparison: fresh-init vs trained weights, identical workloads
+    def gnn_tau(model_root):
+        ev = Evaluator(Config(out=str(tmp_path / f"out_{os.path.basename(model_root)}"),
+                              model_root=model_root, training_set="MID", **kw))
+        ev.try_restore()
+        df = pd.read_csv(ev.run(verbose=False))
+        return (float(np.nanmean(df[df.Algo == "GNN"]["tau"])),
+                float(np.nanmean(df[df.Algo == "local"]["tau"])))
+
+    tau_fresh, _ = gnn_tau(str(tmp_path / "m_fresh"))
+    tau_trained, tau_local = gnn_tau(str(tmp_path / "m_tr"))
+    # calibration: fresh 67.7 -> trained 20.5 (= local); margins are wide
+    assert tau_trained < 0.7 * tau_fresh, (tau_trained, tau_fresh)
+    assert tau_trained < 1.3 * tau_local, (tau_trained, tau_local)
