@@ -133,13 +133,33 @@ def measure():
     dt = time.time() - t0
 
     eps = batch * reps / dt
-    print(json.dumps({
+    rec = {
         "metric": "gnn_actor_critic_episodes_per_sec",
         "value": round(eps, 2),
         "unit": "episodes/sec/chip",
         "vs_baseline": round(eps / REFERENCE_EPISODES_PER_SEC, 2),
         "platform": platform,
-    }))
+        # vs_baseline compares our jitted step rate (device-resident batch)
+        # to the reference's END-TO-END ~9 eps/s — a kernel-vs-pipeline
+        # ratio.  The honest end-to-end multiple is measured separately by
+        # scripts/e2e_throughput.py and committed under benchmarks/.
+        "scope": "jitted forward_backward step rate, device-resident batch",
+    }
+    e2e_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "benchmarks", "end_to_end.json")
+    if os.path.isfile(e2e_path):
+        try:
+            with open(e2e_path) as f:
+                e2e = json.load(f)
+            rec["end_to_end"] = {
+                "instances_per_sec": e2e.get("value"),
+                "vs_reference_sweep": e2e.get("vs_reference_sweep"),
+                "platform": e2e.get("platform"),
+                "source": "benchmarks/end_to_end.json",
+            }
+        except (OSError, ValueError):
+            pass
+    print(json.dumps(rec))
 
 
 def _run_child(extra_env: dict, timeout_s: float):

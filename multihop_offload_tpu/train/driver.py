@@ -89,23 +89,30 @@ class _Harness:
             cfg, self.model, (feats0, support0), self.model_dir
         )
         if not loaded and len(self.data):
-            # fresh init: probe with real features and flip a dead output
-            # unit's sign (models.chebconv.ensure_alive_output)
+            # fresh init: probe with real features from a handful of files
+            # spread across the dataset and flip a dead output unit's sign;
+            # aliveness must hold on EVERY probe, not just file 0
             from multihop_offload_tpu.agent.actor import build_ext_features
-            from multihop_offload_tpu.models.chebconv import ensure_alive_output
+            from multihop_offload_tpu.models.chebconv import (
+                ensure_alive_output_multi,
+            )
 
             probe_rng = np.random.default_rng(cfg.seed)
-            inst0 = self.data.instance(0, probe_rng)
-            js0, _ = sample_jobsets(
-                self.data.records[0], self.data.pad_of(0), 1, probe_rng,
-                cfg.arrival_scale, ul=cfg.ul_data, dl=cfg.dl_data,
-                dtype=cfg.jnp_dtype,
-            )
-            jb0 = jax.tree_util.tree_map(lambda x: x[0], js0)
-            self.variables = ensure_alive_output(
-                self.model, self.variables,
-                build_ext_features(inst0, jb0), inst0.adj_ext,
-                mask=inst0.ext_mask,
+            probe_fids = sorted({0, len(self.data) // 3,
+                                 2 * len(self.data) // 3, len(self.data) - 1})
+            probes = []
+            for fid in probe_fids:
+                inst_p = self.data.instance(fid, probe_rng)
+                js_p, _ = sample_jobsets(
+                    self.data.records[fid], self.data.pad_of(fid), 1,
+                    probe_rng, cfg.arrival_scale, ul=cfg.ul_data,
+                    dl=cfg.dl_data, dtype=cfg.jnp_dtype,
+                )
+                jb_p = jax.tree_util.tree_map(lambda x: x[0], js_p)
+                probes.append((build_ext_features(inst_p, jb_p),
+                               inst_p.adj_ext, inst_p.ext_mask))
+            self.variables = ensure_alive_output_multi(
+                self.model, self.variables, probes
             )
         self.optimizer = make_optimizer(cfg)
         self.opt_state = self.optimizer.init(self.variables["params"])

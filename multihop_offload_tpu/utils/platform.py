@@ -14,10 +14,26 @@ import os
 
 
 def apply_platform_env() -> str | None:
-    """Re-apply JAX_PLATFORMS via jax.config; returns the platform applied."""
+    """Re-apply JAX_PLATFORMS via jax.config; returns the platform applied.
+
+    Also enables a persistent XLA compilation cache (every entry point pays
+    a ~20-40 s first-compile otherwise; sweeps and validation runs re-pay it
+    per process).  Override the location with JAX_COMPILATION_CACHE_DIR, or
+    set it to the empty string to disable.
+    """
+    import jax
+
     platforms = os.environ.get("JAX_PLATFORMS")
     if platforms:
-        import jax
-
         jax.config.update("jax_platforms", platforms)
+    cache_dir = os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "mho_tpu_xla"),
+    )
+    if cache_dir:
+        try:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        except Exception:  # older jax without the knobs: cache is best-effort
+            pass
     return platforms or None

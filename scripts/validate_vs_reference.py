@@ -76,6 +76,9 @@ def main() -> int:
     ap.add_argument("--training_set", default="BAT800",
                     help="checkpoint directory tag, e.g. SCRATCH800 for the "
                          "framework-trained model (restored via orbax)")
+    ap.add_argument("--pad_buckets", type=int, default=1,
+                    help="size buckets (one compile per bucket; less padding "
+                         "waste on the mixed 20-110-node test set)")
     args = ap.parse_args()
     ref_csv = os.path.join(
         REF, "out",
@@ -95,6 +98,7 @@ def main() -> int:
         dtype=args.dtype,
         seed=7,
         compat_diagonal_bug=args.compat_diagonal_bug,
+        pad_buckets=args.pad_buckets,
     )
     ev = Evaluator(cfg)
     restored = ev.try_restore()
