@@ -68,7 +68,9 @@ class Config:
     compat_diagonal_bug: bool = False  # reproduce the reference's cycled
     #                                decision-path diagonal (A/B validation;
     #                                see agent.actor.compat_cycled_diagonal)
-    instance_batch: int = 16       # vmap width (instances per device)
+    file_batch: int = 1            # files evaluated per device program in
+    #                                the Evaluator (vmap over stacked files;
+    #                                multiplies with the data-mesh width)
     pad_nodes: Optional[int] = None    # None = derive from data (next multiple)
     pad_links: Optional[int] = None
     pad_ext: Optional[int] = None

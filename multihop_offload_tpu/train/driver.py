@@ -139,8 +139,12 @@ class _Harness:
                 "(parallel.make_dp_train_step / parallel.ring)"
             )
         self.n_dp = max(1, cfg.mesh_data if cfg.mesh_data > 0 else len(local))
+        # files per Evaluator device program: cfg.file_batch per device,
+        # times the data-mesh width (a 1-device mesh makes the file-batched
+        # path usable on a single chip)
+        self.eval_chunk = self.n_dp * max(1, cfg.file_batch)
         self.mesh = None
-        if self.n_dp > 1:
+        if self.n_dp > 1 or self.eval_chunk > 1:
             from multihop_offload_tpu.parallel.mesh import make_mesh
 
             self.mesh = make_mesh(data=self.n_dp, graph=1,
@@ -214,7 +218,7 @@ class _Harness:
             partial(replay_apply, optimizer=self.optimizer,
                     batch=self.cfg.batch, max_norm=self.cfg.max_norm),
         )
-        if self.n_dp > 1:
+        if self.mesh is not None:
             self._build_dp_steps(model, prob, use_dropout, critic_w, mse_w,
                                  compat_diag, apsp_fn, eval_methods)
 
@@ -247,7 +251,11 @@ class _Harness:
     def save(self, step: int):
         # NOT gated on is_host0: orbax's CheckpointManager is multihost-aware
         # (cross-process barriers inside save/wait_until_finished) — every
-        # process must enter, orbax itself restricts writing to the primary
+        # process must enter, orbax itself restricts writing to the primary.
+        # `step` must be GLOBALLY UNIQUE per save: orbax silently keeps the
+        # FIRST save of an existing step, so re-saving a fixed step id would
+        # freeze the checkpoint at its first write (the Trainer passes the
+        # monotone file-visit counter, never the epoch).
         state = {
             "params": self.variables["params"],
             "opt_state": self.opt_state,
@@ -268,6 +276,9 @@ class _Harness:
         restored = ckpt_lib.restore_checkpoint(directory, state, step)
         self.variables = {"params": restored["params"]}
         self.opt_state = restored["opt_state"]
+        # resumed training continues the visit counter so new saves get
+        # fresh (higher) step ids instead of colliding with existing ones
+        self._resume_step = step + 1
         return step
 
 
@@ -356,7 +367,7 @@ class Trainer(_Harness):
         self.replay_losses = []  # every replay update's mean sampled critic
         #                          loss, in order (the number the reference
         #                          prints per file, `AdHoc_train.py:194-202`)
-        gidx = 0
+        gidx = getattr(self, "_resume_step", 0)
         tb = ScalarLogger(cfg.tb_logdir if self.is_host0 else None)
         for epoch in range(epochs if epochs is not None else cfg.epochs):
             order = self.rng.permutation(len(self.data))
@@ -426,7 +437,7 @@ class Trainer(_Harness):
                 losses.append(loss)
 
                 if np.isfinite(loss):
-                    self.save(epoch)
+                    self.save(gidx)
                     explore = float(np.clip(explore * cfg.explore_decay, 0.0, 1.0))
                     if verbose:
                         print(f"{gidx} Loss: {np.nanmean(losses):.2f}, "
@@ -475,7 +486,7 @@ class Evaluator(_Harness):
                     csv_path, index=False
                 )
 
-        if self.n_dp > 1:
+        if self.eval_chunk > 1:
             self._run_files_dp(n_files, verbose, flush)
         else:
             rows = []
@@ -507,12 +518,13 @@ class Evaluator(_Harness):
         return csv_path
 
     def _run_files_dp(self, n_files: int, verbose: bool, flush):
-        """Shard whole files over the 'data' mesh axis: each chunk stacks
-        `n_dp` same-bucket files (same pad shape) and evaluates them in one
-        sharded program.  The last chunk of a bucket pads by REUSING its
-        final file's instance/jobsets (no extra RNG draws — same seed must
-        mean same workloads as the single-device loop); pad rows are
-        dropped.  Rows are flushed incrementally in file order."""
+        """Batch whole files into one device program: each chunk stacks
+        `eval_chunk` same-bucket files (same pad shape) — `file_batch` per
+        device, vmapped — sharded over the 'data' mesh axis.  The last
+        chunk of a bucket pads by REUSING its final file's
+        instance/jobsets (no extra RNG draws — same seed must mean same
+        workloads as the single-device loop); pad rows are dropped.
+        Rows are flushed incrementally in file order."""
         cfg = self.cfg
         from multihop_offload_tpu.graphs.instance import stack_instances
 
@@ -522,8 +534,8 @@ class Evaluator(_Harness):
         rows_by_fid = {}
         done = 0
         for bucket, fids in sorted(by_bucket.items()):
-            for c0 in range(0, len(fids), self.n_dp):
-                chunk = fids[c0: c0 + self.n_dp]
+            for c0 in range(0, len(fids), self.eval_chunk):
+                chunk = fids[c0: c0 + self.eval_chunk]
                 real = len(chunk)
                 insts, jsets, cnts = [], [], []
                 for fid in chunk:
@@ -537,22 +549,24 @@ class Evaluator(_Harness):
                     )
                     jsets.append(js)
                     cnts.append(counts)
-                for _ in range(self.n_dp - real):  # pad slots: no RNG draws
+                for _ in range(self.eval_chunk - real):  # pad: no RNG draws
                     insts.append(insts[-1])
                     jsets.append(jsets[-1])
                 binst = stack_instances(insts)
                 bjobs = stack_instances(jsets)
-                keys = self.next_keys(self.n_dp * cfg.num_instances).reshape(
-                    self.n_dp, cfg.num_instances, -1
-                )
+                keys = self.next_keys(
+                    self.eval_chunk * cfg.num_instances
+                ).reshape(self.eval_chunk, cfg.num_instances, -1)
                 t0 = time.time()
                 bl, loc, gnn = self._eval_files_dp(
                     self.variables, binst, bjobs, keys
                 )
                 jax.block_until_ready(gnn)
                 # normalize by the full chunk width: pad slots run in
-                # parallel, so per-eval cost is t/(3*I*n_dp) for every chunk
-                runtime = (time.time() - t0) / (3 * cfg.num_instances * self.n_dp)
+                # parallel, so per-eval cost is t/(3*I*eval_chunk) per chunk
+                runtime = (time.time() - t0) / (
+                    3 * cfg.num_instances * self.eval_chunk
+                )
                 for d in range(real):
                     fid = chunk[d]
                     metrics = _method_metrics(
@@ -566,5 +580,6 @@ class Evaluator(_Harness):
                 done += real
                 if verbose:
                     print(f"[{done}/{n_files}] bucket {bucket} chunk of {real} "
-                          f"({(time.time() - t0):.3f}s on {self.n_dp} devices)")
+                          f"({(time.time() - t0):.3f}s, chunk {self.eval_chunk} "
+                          f"on {self.n_dp} devices)")
                 flush([r for f in sorted(rows_by_fid) for r in rows_by_fid[f]])

@@ -23,8 +23,18 @@ def apply_platform_env() -> str | None:
     """
     import jax
 
+    current = jax.config.jax_platforms
     platforms = os.environ.get("JAX_PLATFORMS")
-    if platforms:
+    if current == "cpu":
+        # an explicit in-process CPU pin (pytest conftest, a test script's
+        # config.update) wins over the host environment: this host exports
+        # JAX_PLATFORMS=axon globally AND sitecustomize pre-sets the
+        # platforms config, so re-applying the env would flip a
+        # deliberately-CPU process onto the remote accelerator backend
+        # mid-run.  Any other current value is the ambient sitecustomize
+        # default, which the env var (the documented override) replaces.
+        platforms = current
+    elif platforms and platforms != current:
         jax.config.update("jax_platforms", platforms)
     cache_dir = os.environ.get(
         "JAX_COMPILATION_CACHE_DIR",

@@ -49,6 +49,8 @@ def main() -> int:
     ap.add_argument("--files", type=int, default=None)
     ap.add_argument("--scale", type=float, default=0.15)
     ap.add_argument("--pad_buckets", type=int, default=4)
+    ap.add_argument("--file_batch", type=int, default=8,
+                    help="files per device program (amortizes dispatch)")
     ap.add_argument("--out", default="benchmarks/end_to_end.json")
     args = ap.parse_args()
 
@@ -68,6 +70,7 @@ def main() -> int:
         dtype="float32",
         seed=7,
         pad_buckets=args.pad_buckets,
+        file_batch=args.file_batch,
     )
     # the Evaluator's _init_params loads the reference TF checkpoint via the
     # model_dir's `checkpoint` file (same path bench.py uses); try_restore is
