@@ -220,3 +220,20 @@ def test_file_batched_evaluator_matches_plain(tmp_path, tiny_dataset, monkeypatc
             ["filename", "Algo", "n_instance"]
         )[cols].reset_index(drop=True)
     pd.testing.assert_frame_equal(dfs["plain"], dfs["batched"])
+
+
+def test_apsp_impl_knob_plumbs_through_evaluator(tmp_path, tiny_dataset, monkeypatch):
+    """apsp_impl='pallas' resolves to the self-dispatching Pallas wrapper
+    (XLA fallback off-TPU) and must give identical results to 'xla'."""
+    monkeypatch.chdir(tmp_path)
+    cols = ["filename", "n_instance", "Algo", "tau", "congest_jobs"]
+    dfs = {}
+    for impl in ("xla", "pallas"):
+        cfg = _cfg(tmp_path, tiny_dataset, mesh_data=1, apsp_impl=impl,
+                   out=str(tmp_path / f"out_{impl}"))
+        ev = Evaluator(cfg)
+        assert ev.apsp_path == ("xla" if impl == "xla" else "xla-fallback")
+        dfs[impl] = pd.read_csv(ev.run(files_limit=2, verbose=False)).sort_values(
+            ["filename", "Algo", "n_instance"]
+        )[cols].reset_index(drop=True)
+    pd.testing.assert_frame_equal(dfs["xla"], dfs["pallas"])
