@@ -79,6 +79,21 @@ class _Harness:
     def __init__(self, cfg: Config, datapath: Optional[str] = None,
                  memory_size: Optional[int] = None):
         self.cfg = cfg
+        if cfg.dtype == "float64" and not jax.config.jax_enable_x64:
+            # without this, float64 requests are SILENTLY truncated to
+            # float32 (jax default) — the run would be mislabeled.  The
+            # flag is process-global and one-way: warn, because a float32
+            # harness built later in this process will compute weak-typed
+            # scalars in 64-bit (the same condition the test suite runs
+            # under — conftest enables x64 globally)
+            import warnings
+
+            warnings.warn(
+                "enabling jax_enable_x64 process-wide for a float64 run; "
+                "later float32 harnesses in this process inherit it",
+                RuntimeWarning, stacklevel=2,
+            )
+            jax.config.update("jax_enable_x64", True)
         self.data = DatasetCache.load(cfg, datapath)
         self.model = make_model(cfg)
         pad = self.data.pad
