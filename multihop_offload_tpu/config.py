@@ -44,6 +44,10 @@ class Config:
     num_instances: int = 10        # job-placement instances per network
     files_limit: Optional[int] = None  # cap network files visited per epoch
     #                                (bounded training slices; None = all)
+    best_window: int = 20          # rolling window (file visits) of GNN-test
+    #                                tau used for best-checkpoint tracking;
+    #                                0 disables.  Motivated by the measured
+    #                                late-training collapse (training/README)
     explore: float = 0.1           # driver-level epsilon-greedy exploration
     explore_decay: float = 0.99
     memory_size: int = 5000        # gradient-replay capacity (train); 1000 test

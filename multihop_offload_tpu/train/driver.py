@@ -278,8 +278,29 @@ class _Harness:
         }
         ckpt_lib.save_checkpoint(os.path.join(self.model_dir, "orbax"), step, state)
 
-    def try_restore(self) -> Optional[int]:
-        directory = os.path.join(self.model_dir, "orbax")
+    def save_best(self, step: int, tau: float):
+        """Best-so-far checkpoint (rolling GNN-test tau): the training
+        dynamics COLLAPSE late (training/README.md — ours and the
+        reference's own logs), so the best policy is usually not the last.
+        Kept in a separate orbax tree so `max_to_keep` pruning of the
+        resume chain never evicts it."""
+        state = {
+            "params": self.variables["params"],
+            "opt_state": self.opt_state,
+            "step": step,
+        }
+        directory = os.path.join(self.model_dir, "orbax_best")
+        ckpt_lib.save_checkpoint(directory, step, state)
+        if self.is_host0:
+            import json
+
+            with open(os.path.join(directory, "best.json"), "w") as f:
+                json.dump({"step": step, "rolling_gnn_test_tau": tau}, f)
+
+    def try_restore(self, which: str = "latest") -> Optional[int]:
+        directory = os.path.join(
+            self.model_dir, "orbax_best" if which == "best" else "orbax"
+        )
         step = ckpt_lib.latest_step(directory)
         if step is None:
             return None
@@ -291,9 +312,12 @@ class _Harness:
         restored = ckpt_lib.restore_checkpoint(directory, state, step)
         self.variables = {"params": restored["params"]}
         self.opt_state = restored["opt_state"]
-        # resumed training continues the visit counter so new saves get
-        # fresh (higher) step ids instead of colliding with existing ones
-        self._resume_step = step + 1
+        # resumed training continues the visit counter PAST every existing
+        # step in the resume chain (not just the restored one — restoring
+        # `best` then saving at an id the `orbax` tree already holds would
+        # be silently dropped, the frozen-checkpoint failure mode)
+        latest = ckpt_lib.latest_step(os.path.join(self.model_dir, "orbax"))
+        self._resume_step = max(step, latest if latest is not None else -1) + 1
         return step
 
 
@@ -382,6 +406,18 @@ class Trainer(_Harness):
         self.replay_losses = []  # every replay update's mean sampled critic
         #                          loss, in order (the number the reference
         #                          prints per file, `AdHoc_train.py:194-202`)
+        from collections import deque
+
+        best_roll = deque(maxlen=max(cfg.best_window, 1))
+        # resumed runs must not let a worse post-resume window overwrite
+        # the standing best: seed the bar from the recorded best
+        self.best_tau = float("inf")
+        best_json = os.path.join(self.model_dir, "orbax_best", "best.json")
+        if os.path.isfile(best_json):
+            import json
+
+            with open(best_json) as f:
+                self.best_tau = float(json.load(f)["rolling_gnn_test_tau"])
         gidx = getattr(self, "_resume_step", 0)
         tb = ScalarLogger(cfg.tb_logdir if self.is_host0 else None)
         for epoch in range(epochs if epochs is not None else cfg.epochs):
@@ -438,6 +474,14 @@ class Trainer(_Harness):
                     bl, jobsets.mask, float(cfg.T),
                 )
                 rows += _rows(rec, counts, metrics, runtime, gidx)
+
+                # best-checkpoint tracking on rolling GNN-test tau
+                if cfg.best_window > 0:
+                    best_roll.append(float(np.nanmean(metrics["GNN-test"][0])))
+                    roll = float(np.mean(best_roll))
+                    if len(best_roll) == cfg.best_window and roll < self.best_tau:
+                        self.best_tau = roll
+                        self.save_best(gidx, roll)
 
                 # replay: the only weight update (`AdHoc_train.py:187`)
                 loss = float("nan")

@@ -79,6 +79,10 @@ def main() -> int:
     ap.add_argument("--pad_buckets", type=int, default=1,
                     help="size buckets (one compile per bucket; less padding "
                          "waste on the mixed 20-110-node test set)")
+    ap.add_argument("--checkpoint", default="latest",
+                    choices=["latest", "best"],
+                    help="which orbax tree to restore for --training_set "
+                         "models (best = rolling-tau best, training/README)")
     args = ap.parse_args()
     ref_csv = os.path.join(
         REF, "out",
@@ -101,9 +105,16 @@ def main() -> int:
         pad_buckets=args.pad_buckets,
     )
     ev = Evaluator(cfg)
-    restored = ev.try_restore()
+    restored = ev.try_restore(which=args.checkpoint)
     if restored is not None:
-        print(f"restored orbax step {restored} from {cfg.model_dir()}")
+        print(f"restored orbax step {restored} ({args.checkpoint}) "
+              f"from {cfg.model_dir()}")
+    elif args.checkpoint == "best":
+        # an explicit --checkpoint best with no best tree must not fall
+        # through to evaluating init weights under a trained-model label
+        print(f"ERROR: no orbax_best checkpoint under {cfg.model_dir()}",
+              file=sys.stderr)
+        return 2
     csv_path = ev.run(files_limit=args.files, verbose=True)
 
     ours = pd.read_csv(csv_path)

@@ -237,3 +237,26 @@ def test_apsp_impl_knob_plumbs_through_evaluator(tmp_path, tiny_dataset, monkeyp
             ["filename", "Algo", "n_instance"]
         )[cols].reset_index(drop=True)
     pd.testing.assert_frame_equal(dfs["xla"], dfs["pallas"])
+
+
+def test_best_checkpoint_tracking(tmp_path, tiny_dataset, monkeypatch):
+    """The Trainer keeps a separate best-rolling-tau checkpoint that
+    restores independently of the latest (training collapses late —
+    training/README.md)."""
+    import json
+
+    monkeypatch.chdir(tmp_path)
+    cfg = _cfg(tmp_path, tiny_dataset, mesh_data=1, best_window=2,
+               model_root=str(tmp_path / "m_best"))
+    tr = Trainer(cfg)
+    tr.run(epochs=2, verbose=False)
+    best_dir = os.path.join(cfg.model_dir(), "orbax_best")
+    assert os.path.isdir(best_dir)
+    with open(os.path.join(best_dir, "best.json")) as f:
+        rec = json.load(f)
+    assert np.isfinite(rec["rolling_gnn_test_tau"])
+    assert rec["rolling_gnn_test_tau"] == tr.best_tau
+    # best restores, and may differ from latest
+    ev = Evaluator(Config(**{**cfg.__dict__}))
+    step_best = ev.try_restore(which="best")
+    assert step_best == rec["step"]
