@@ -121,12 +121,15 @@ def build_instance(
     pad: PadSpec,
     dtype=np.float32,
     hop: Optional[np.ndarray] = None,
+    device: bool = True,
 ) -> Instance:
     """Freeze a topology + resource assignment into a padded Instance.
 
     `hop` optionally supplies the padded (pad.n, pad.n) hop-count matrix —
     it depends only on the topology, so repeat builds of the same case
     (per-visit link-rate re-realization) can cache it (`compute_hop_matrix`).
+    `device=False` keeps numpy leaves so callers that stack many instances
+    can ship one batched transfer (`stack_instances`).
     """
     n, l = topo.n, topo.num_links
     N, L, S = pad.n, pad.l, pad.s
@@ -199,7 +202,7 @@ def build_instance(
         ext_mask=ext_mask, servers=servers, server_mask=server_mask,
         hop=hop, T=np.asarray(t_max, dtype=dtype),
     )
-    return to_device(inst)
+    return to_device(inst) if device else inst
 
 
 def compute_hop_matrix(topo: Topology, pad_n: int) -> np.ndarray:
@@ -224,6 +227,7 @@ def build_jobset(
     ul: float = 100.0,
     dl: float = 1.0,
     dtype=np.float32,
+    device: bool = True,
 ) -> JobSet:
     """Pad a concrete workload (job defaults from `offloading_v3.py:132`)."""
     src = np.asarray(src, dtype=np.int32)
@@ -238,11 +242,12 @@ def build_jobset(
     rate_p[:j] = rate
     mask = np.zeros((J,), dtype=bool)
     mask[:j] = True
-    return to_device(JobSet(
+    js = JobSet(
         src=src_p, rate=rate_p,
         ul=np.full((J,), ul, dtype=dtype), dl=np.full((J,), dl, dtype=dtype),
         mask=mask,
-    ))
+    )
+    return to_device(js) if device else js
 
 
 def to_device(tree):
@@ -254,8 +259,18 @@ def to_device(tree):
 
 
 def stack_instances(items: Sequence):
-    """Stack same-shape pytrees into a batched pytree (the vmap axis)."""
+    """Stack same-shape pytrees into a batched pytree (the vmap axis).
+
+    numpy leaves (from `build_instance(..., device=False)`) are stacked on
+    host and shipped in ONE transfer per leaf — batching N instances costs
+    ~20 `device_put`s total instead of ~20N (the drivers' host pipeline is
+    what end-to-end throughput amortizes; see benchmarks/README.md)."""
     import jax
     import jax.numpy as jnp
 
+    if all(isinstance(leaf, np.ndarray) or np.isscalar(leaf)
+           for leaf in jax.tree_util.tree_leaves(items[0])):
+        return jax.tree_util.tree_map(
+            lambda *xs: jnp.asarray(np.stack(xs)), *items
+        )
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *items)

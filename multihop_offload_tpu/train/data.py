@@ -107,9 +107,12 @@ class DatasetCache:
             hop = compute_hop_matrix(rec.topo, pad.n)
             self._hop_cache[idx] = hop
         rates = sample_link_rates(rec.topo, rec.link_rates, rng=rng)
+        # numpy leaves: jit transfers on call, and batch stacking ships one
+        # transfer per leaf instead of one per instance
         return build_instance(
             rec.topo, rec.roles, rec.proc_bws, rates,
             float(self.cfg.T), pad, dtype=self.cfg.jnp_dtype, hop=hop,
+            device=False,
         )
 
 
@@ -136,7 +139,8 @@ def sample_jobsets(
         nj = int(rng.integers(lo, mobile.size)) if mobile.size > lo else mobile.size
         rates = arrival_scale * rng.uniform(0.1, 0.5, nj)
         sets.append(
-            build_jobset(mobile[:nj], rates, pad_jobs=pad.j, ul=ul, dl=dl, dtype=dtype)
+            build_jobset(mobile[:nj], rates, pad_jobs=pad.j, ul=ul, dl=dl,
+                         dtype=dtype, device=False)
         )
         counts.append(nj)
     return stack_instances(sets), np.asarray(counts)

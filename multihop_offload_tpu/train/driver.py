@@ -426,7 +426,12 @@ class Trainer(_Harness):
                 order = order[:files_limit]
             for fid in order:
                 rec = self.data.records[fid]
-                inst = self.data.instance(fid, self.rng)
+                # one transfer up front: this inst feeds TWO jit calls
+                # (train step + eval methods); numpy leaves would be
+                # device_put twice
+                from multihop_offload_tpu.graphs.instance import to_device
+
+                inst = to_device(self.data.instance(fid, self.rng))
                 jobsets, counts = sample_jobsets(
                     rec, self.data.pad_of(fid), cfg.num_instances, self.rng,
                     cfg.arrival_scale, ul=cfg.ul_data, dl=cfg.dl_data,
