@@ -68,6 +68,17 @@ def _pallas_call(adj, rates, cf, lam, iters: int, interpret: bool):
     )(adj, rates, cf, lam)
 
 
+def fixed_point_path(interpret: bool = False) -> str:
+    """Which implementation `fixed_point_pallas` actually runs:
+    'pallas' | 'xla-fallback' — same honesty contract as
+    `minplus.pallas_apsp_path` (callers report the executed path)."""
+    if interpret:
+        return "pallas"
+    from multihop_offload_tpu.ops.minplus import tpu_backend
+
+    return "pallas" if tpu_backend() else "xla-fallback"
+
+
 def _xla_reference(adj, rates, cf, lam, num_iters):
     # the one true update lives in env.queueing; the VJP recompute must pull
     # back through exactly the math the rest of the framework runs
@@ -86,7 +97,15 @@ def fixed_point_pallas(
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Drop-in `interference_fixed_point` core: (L, L), (L,), (L,), (L,) ->
-    converged mu (L,).  Also accepts a leading batch axis on every operand."""
+    converged mu (L,).  Also accepts a leading batch axis on every operand.
+    Off-TPU (and not interpreting) it delegates to the XLA reference — same
+    dispatch contract as `minplus.apsp_minplus_pallas`."""
+    if not interpret:
+        from multihop_offload_tpu.ops.minplus import _tpu_backend
+
+        if not _tpu_backend():
+            return _xla_reference(adj_conflict, link_rates, cf_degs,
+                                  link_lambda, num_iters)
     squeeze = adj_conflict.ndim == 2
     adj = adj_conflict[None] if squeeze else adj_conflict
     vecs = [x[None] if squeeze else x for x in (link_rates, cf_degs, link_lambda)]

@@ -235,20 +235,24 @@ def blocked_fw_call(
     return lax.fori_loop(0, nb, step, d)
 
 
-def _tpu_backend() -> bool:
+def tpu_backend() -> bool:
     """Mosaic kernels only lower on TPU (incl. the tunneled 'axon' platform);
-    elsewhere the dispatcher must delegate to XLA unless interpreting."""
+    elsewhere dispatchers (here and `ops.fixed_point`) must delegate to XLA
+    unless interpreting."""
     try:
         return jax.default_backend() in ("tpu", "axon")
     except Exception:  # backend init failure: let the XLA path surface it
         return False
 
 
+_tpu_backend = tpu_backend  # transitional alias
+
+
 def pallas_apsp_path(n: int, interpret: bool = False) -> str:
     """Which implementation `apsp_minplus_pallas` actually runs for size n:
     'squaring' | 'blocked-fw' | 'xla-fallback'.  Lets callers (e.g.
     `scripts/large_scale_demo.py`) report the executed path honestly."""
-    if not interpret and not _tpu_backend():
+    if not interpret and not tpu_backend():
         return "xla-fallback"
     n_pad = max(_LANE, math.ceil(n / _LANE) * _LANE)
     if n_pad <= _MAX_SQUARING_N:

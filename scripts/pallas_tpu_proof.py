@@ -115,9 +115,10 @@ def run_step(name: str) -> dict:
         rec["xla_ms"] = round(_time(xla_fn, w), 3)
     else:
         from multihop_offload_tpu.ops.fixed_point import (
-            _xla_reference, fixed_point_pallas,
+            _xla_reference, fixed_point_pallas, fixed_point_path,
         )
 
+        rec["pallas_path"] = fixed_point_path()
         l = size
         adj = (_rand_weights(l, batch, rng) < np.inf).astype(np.float32)
         for i in range(batch):

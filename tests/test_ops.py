@@ -245,3 +245,27 @@ def test_blocked_fw_asymmetric_and_batched():
         finite = np.isfinite(e)
         np.testing.assert_allclose(got[b][finite], e[finite], rtol=1e-6)
         assert (np.isinf(got[b]) == np.isinf(e)).all()
+
+
+def test_fixed_point_off_tpu_fallback_matches_reference(small_cases, rng):
+    """interpret=False off-TPU must delegate to the XLA reference (the
+    dispatch contract shared with apsp_minplus_pallas) — values identical,
+    and fixed_point_path reports the fallback honestly."""
+    import numpy as np
+
+    from multihop_offload_tpu.ops.fixed_point import (
+        _xla_reference, fixed_point_pallas, fixed_point_path,
+    )
+
+    assert fixed_point_path() == "xla-fallback"  # suite runs on CPU
+    l, b = 64, 3
+    adj = (rng.random((b, l, l)) < 0.1).astype(np.float32)
+    for i in range(b):
+        adj[i] = np.maximum(adj[i], adj[i].T)
+        np.fill_diagonal(adj[i], 0.0)
+    rates = rng.uniform(30, 70, (b, l)).astype(np.float32)
+    cf = adj.sum(-1).astype(np.float32)
+    lam = rng.uniform(0, 5, (b, l)).astype(np.float32)
+    out = fixed_point_pallas(adj, rates, cf, lam, 10, False)
+    ref = _xla_reference(adj, rates, cf, lam, 10)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-6)
