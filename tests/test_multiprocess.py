@@ -1,0 +1,89 @@
+"""REAL multi-process distributed bring-up (not virtual devices).
+
+Two OS processes join via `parallel.mesh.init_distributed`'s explicit
+coordinator path (the framework's NCCL/MPI-equivalent entry, SURVEY.md
+§5.8), see each other's devices globally, and run a cross-process `psum`
+over a 2-device ('data',) mesh — the DCN collective path the multi-host
+Trainer rides.  Each child also checks `jax.process_index()` (the
+host-0 write gating the drivers rely on).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+_CHILD = r'''
+import os, sys
+sys.path.insert(0, os.environ["MHO_REPO"])
+import jax
+jax.config.update("jax_platforms", "cpu")
+from multihop_offload_tpu.parallel.mesh import init_distributed
+
+pid = int(sys.argv[1])
+idx = init_distributed(coordinator_address=os.environ["MHO_COORD"],
+                       num_processes=2, process_id=pid)
+assert idx == pid == jax.process_index(), (idx, pid, jax.process_index())
+
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+devs = jax.devices()
+assert len(devs) == 2, f"expected 2 global devices, got {devs}"
+mesh = Mesh(np.asarray(devs), ("data",))
+f = jax.jit(shard_map(lambda x: jax.lax.psum(x, "data"), mesh=mesh,
+                      in_specs=P(), out_specs=P(), check_vma=False))
+out = float(f(jnp.asarray(float(pid + 1))))
+assert out == 3.0, out  # 1 + 2 across processes
+print(f"PROC {pid} OK psum={out}", flush=True)
+'''
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_distributed_psum():
+    # bounded by the children's communicate(timeout=240) below
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ,
+           "MHO_REPO": repo,
+           "MHO_COORD": f"127.0.0.1:{_free_port()}",
+           # children must pick their own platform; scrub inherited forcing
+           "JAX_PLATFORMS": "",
+           "XLA_FLAGS": ""}
+    procs = [
+        subprocess.Popen([sys.executable, "-c", _CHILD, str(i)], env=env,
+                         stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for i in range(2)
+    ]
+    outs = ["", ""]
+    try:
+        for i, p in enumerate(procs):
+            try:
+                out, _ = p.communicate(timeout=240)
+                outs[i] = out.decode()
+            except subprocess.TimeoutExpired:
+                # a hang here usually means the OTHER process died early and
+                # this one is waiting for it in initialize(); kill both and
+                # surface every captured output so the root cause is visible
+                for q in procs:
+                    q.kill()
+                for j, q in enumerate(procs):
+                    out, _ = q.communicate()
+                    outs[j] = outs[j] or out.decode()
+                raise AssertionError(
+                    "distributed bring-up timed out; outputs:\n"
+                    + "\n".join(f"--- proc {j}:\n{o[-2000:]}"
+                                for j, o in enumerate(outs))
+                )
+    finally:
+        for p in procs:
+            p.kill()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} rc={p.returncode}:\n{out[-2000:]}"
+        assert f"PROC {i} OK" in out
