@@ -1,4 +1,8 @@
-from multihop_offload_tpu.parallel.mesh import make_mesh  # noqa: F401
+from multihop_offload_tpu.parallel.mesh import (  # noqa: F401
+    global_batch,
+    init_distributed,
+    make_mesh,
+)
 from multihop_offload_tpu.parallel.ring import (  # noqa: F401
     ring_minplus_square,
     sharded_apsp,

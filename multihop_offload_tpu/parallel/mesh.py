@@ -15,7 +15,7 @@ from typing import Optional, Sequence
 
 import jax
 import numpy as np
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 
 def make_mesh(
@@ -32,6 +32,27 @@ def make_mesh(
         )
     grid = np.asarray(devices[: data * graph]).reshape(data, graph)
     return Mesh(grid, axis_names=("data", "graph"))
+
+
+def global_batch(mesh: Mesh, tree, axis: str = "data"):
+    """Assemble per-process LOCAL batches into global `jax.Array`s sharded
+    over `axis` — the multi-host data-parallel input path.
+
+    Single-process callers can feed host-local numpy straight into a
+    `shard_map`; with multiple processes each process holds only its shard
+    of the episode batch, and XLA requires a global array whose addressable
+    shards are this process's data.  Every process passes its local
+    (B_local, ...) leaves; the result behaves as the concatenated
+    (B_local * num_processes, ...) batch laid out over `axis`.
+    """
+    def put(x):
+        x = np.asarray(x)
+        spec = PartitionSpec(axis, *([None] * (x.ndim - 1)))
+        return jax.make_array_from_process_local_data(
+            NamedSharding(mesh, spec), x
+        )
+
+    return jax.tree_util.tree_map(put, tree)
 
 
 def init_distributed(

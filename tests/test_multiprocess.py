@@ -47,17 +47,21 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_distributed_psum():
-    # bounded by the children's communicate(timeout=240) below
+def _run_children(child_src: str, xla_flags: str = "", timeout: int = 240):
+    """Spawn 2 coordinator-joined children; return their outputs.
+
+    On a hang (usually: the OTHER process died early and this one waits in
+    initialize()/a collective) kill both and surface every captured output.
+    """
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = {**os.environ,
            "MHO_REPO": repo,
            "MHO_COORD": f"127.0.0.1:{_free_port()}",
            # children must pick their own platform; scrub inherited forcing
            "JAX_PLATFORMS": "",
-           "XLA_FLAGS": ""}
+           "XLA_FLAGS": xla_flags}
     procs = [
-        subprocess.Popen([sys.executable, "-c", _CHILD, str(i)], env=env,
+        subprocess.Popen([sys.executable, "-c", child_src, str(i)], env=env,
                          stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
         for i in range(2)
     ]
@@ -65,12 +69,9 @@ def test_two_process_distributed_psum():
     try:
         for i, p in enumerate(procs):
             try:
-                out, _ = p.communicate(timeout=240)
+                out, _ = p.communicate(timeout=timeout)
                 outs[i] = out.decode()
             except subprocess.TimeoutExpired:
-                # a hang here usually means the OTHER process died early and
-                # this one is waiting for it in initialize(); kill both and
-                # surface every captured output so the root cause is visible
                 for q in procs:
                     q.kill()
                 for j, q in enumerate(procs):
@@ -79,7 +80,7 @@ def test_two_process_distributed_psum():
                 raise AssertionError(
                     "distributed bring-up timed out; outputs:\n"
                     + "\n".join(f"--- proc {j}:\n{o[-2000:]}"
-                                for j, o in enumerate(outs))
+                                 for j, o in enumerate(outs))
                 )
     finally:
         for p in procs:
@@ -87,3 +88,72 @@ def test_two_process_distributed_psum():
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} rc={p.returncode}:\n{out[-2000:]}"
         assert f"PROC {i} OK" in out
+    return outs
+
+
+def test_two_process_distributed_psum():
+    _run_children(_CHILD)
+
+
+_TRAIN_CHILD = r'''
+import os, sys
+sys.path.insert(0, os.environ["MHO_REPO"])
+import jax
+jax.config.update("jax_platforms", "cpu")
+from multihop_offload_tpu.parallel.mesh import (
+    global_batch, init_distributed, make_mesh,
+)
+
+pid = int(sys.argv[1])
+init_distributed(coordinator_address=os.environ["MHO_COORD"],
+                 num_processes=2, process_id=pid)
+
+import numpy as np
+import jax.numpy as jnp
+import __graft_entry__ as ge
+from multihop_offload_tpu.agent import make_optimizer
+from multihop_offload_tpu.config import Config
+from multihop_offload_tpu.models import ChebNet
+from multihop_offload_tpu.parallel.data_parallel import make_dp_train_step
+
+devs = jax.devices()
+assert len(devs) == 4, devs  # 2 processes x 2 local devices
+mesh = make_mesh(data=4, graph=1, devices=devs)
+# each process builds its OWN local episodes (different seeds) — true data
+# parallelism across hosts, not replicated work
+binst, bjobs, pad = ge._make_batch(num_cases=2, n_nodes=20, pad_round=8,
+                                   dtype=np.float32, seed=100 + pid)
+model = ChebNet(num_layer=3, hidden=8, param_dtype=jnp.float32)
+variables = model.init(jax.random.PRNGKey(0),
+                       jnp.zeros((pad.e, 4), jnp.float32),
+                       jnp.zeros((pad.e, pad.e), jnp.float32))
+opt = make_optimizer(Config(learning_rate=1e-4))
+opt_state = opt.init(variables["params"])
+keys = jax.random.split(jax.random.PRNGKey(1 + pid), 2)
+g_inst, g_jobs, g_keys = global_batch(mesh, (binst, bjobs, np.asarray(keys)))
+step = make_dp_train_step(model, opt, mesh, mode="mean")
+new_vars, new_opt, metrics = step(variables, opt_state, g_inst, g_jobs,
+                                  g_keys, jnp.asarray(0.1, jnp.float32))
+loss = float(jax.device_get(metrics["loss_critic"]))
+assert np.isfinite(loss)
+print(f"PROC {pid} LOSS {loss:.6f}", flush=True)
+print(f"PROC {pid} OK", flush=True)
+'''
+
+
+def test_two_process_data_parallel_training_step():
+    """TRUE multi-host DP: each process contributes its OWN episodes into a
+    4-device (2 processes x 2 devices) mesh via `global_batch`, one
+    psum-mean update runs, and both processes agree on the cross-host
+    loss — the scheme the reference's NCCL/MPI-equivalent would provide."""
+    outs = _run_children(
+        _TRAIN_CHILD, xla_flags="--xla_force_host_platform_device_count=2",
+        timeout=400,
+    )
+    losses = [
+        [ln for ln in out.splitlines() if "LOSS" in ln][-1].split()[-1]
+        for out in outs
+    ]
+    # the psum-mean loss must be identical on every host (it aggregates
+    # episodes only the other process holds)
+    assert losses[0] == losses[1], losses
