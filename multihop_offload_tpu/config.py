@@ -66,9 +66,13 @@ class Config:
     # ---- TPU-native knobs -------------------------------------------------
     dtype: str = "float32"         # computation dtype ("float64" for parity)
     apsp_impl: str = "xla"         # all-pairs-shortest-path kernel for the
-    #                                decision paths: xla | pallas | auto
-    #                                (ops.minplus.resolve_apsp; pallas falls
-    #                                back to XLA off-TPU or beyond size caps)
+    #                                decision paths: xla | pallas | auto.
+    #                                auto = fastest measured path per shape
+    #                                (benchmarks/pallas_tpu.json: XLA below
+    #                                padded N=512, Pallas blocked-FW above);
+    #                                pallas forces the kernel (XLA fallback
+    #                                off-TPU or beyond size caps).  See
+    #                                ops.minplus.resolve_apsp.
     compat_diagonal_bug: bool = False  # reproduce the reference's cycled
     #                                decision-path diagonal (A/B validation;
     #                                see agent.actor.compat_cycled_diagonal)

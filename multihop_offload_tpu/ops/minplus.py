@@ -82,6 +82,12 @@ _MAX_SQUARING_N = 256  # largest padded size where the whole-matrix VMEM
 _MAX_BLOCKED_N = 2048  # blocked-FW ceiling: above this the (B, N, N) HBM
 #                        residency and per-call latency favor the
 #                        ring-sharded APSP (`parallel.ring`) across chips.
+_AUTO_PALLAS_MIN_N = 512  # measured crossover on a real v5e chip
+#                        (benchmarks/pallas_tpu.json): XLA squaring beats the
+#                        Pallas kernels up to padded N=384 (0.62-0.97x); the
+#                        blocked FW wins from 512 (2.43x) through 1024
+#                        (4.93x).  `apsp_impl='auto'` dispatches on this;
+#                        'pallas' forces the kernel regardless (proof runs).
 
 
 # --------------------------- blocked Floyd-Warshall ------------------------
@@ -262,21 +268,56 @@ def pallas_apsp_path(n: int, interpret: bool = False) -> str:
     return "xla-fallback"
 
 
+def auto_apsp_path(n: int, interpret: bool = False) -> str:
+    """Path `apsp_impl='auto'` takes for size n: the fastest MEASURED
+    implementation on real hardware (`benchmarks/pallas_tpu.json`) — XLA
+    below the `_AUTO_PALLAS_MIN_N` crossover, Pallas blocked-FW above."""
+    n_pad = max(_LANE, math.ceil(n / _LANE) * _LANE)
+    if n_pad < _AUTO_PALLAS_MIN_N:
+        return "xla"
+    return pallas_apsp_path(n, interpret=interpret)
+
+
+def apsp_minplus_auto(
+    weights: jnp.ndarray,
+    num_iters: int | None = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Measured-crossover dispatch: delegate to the XLA squaring below
+    `_AUTO_PALLAS_MIN_N` (where it beats the kernels on-chip), to
+    `apsp_minplus_pallas` above.  Re-resolves per call shape, so bucketed
+    mixed-size datasets each get the fastest kernel."""
+    if auto_apsp_path(weights.shape[-1], interpret=interpret) == "xla":
+        from multihop_offload_tpu.env.apsp import apsp_minplus
+
+        if weights.ndim == 2:
+            return apsp_minplus(weights, num_iters)
+        return jax.vmap(lambda m: apsp_minplus(m, num_iters))(weights)
+    return apsp_minplus_pallas(weights, num_iters, interpret=interpret)
+
+
 def resolve_apsp(impl: str, n: int, interpret: bool = False):
     """Resolve the config knob `apsp_impl` to an APSP callable.
 
     Returns ``(apsp_fn, path)``.  ``apsp_fn`` is None for the default XLA
-    min-plus squaring (callers treat None as `env.apsp.apsp_minplus`); for
-    'pallas'/'auto' it is `apsp_minplus_pallas`, which re-resolves PER CALL
-    SHAPE (squaring <= 256, blocked FW <= 2048, XLA beyond / off-TPU) — so
-    mixed-size bucketed datasets each get the right kernel.  ``path`` is the
-    resolution REPORT for size ``n`` ('xla' | 'squaring' | 'blocked-fw' |
-    'xla-fallback'); other bucket sizes may resolve differently.
+    min-plus squaring (callers treat None as `env.apsp.apsp_minplus`).
+    'auto' picks the fastest measured path per call shape
+    (`benchmarks/pallas_tpu.json`: XLA below padded N=512, blocked FW
+    above); 'pallas' forces `apsp_minplus_pallas`, which self-dispatches
+    (squaring <= 256, blocked FW <= 2048, XLA beyond / off-TPU).  ``path``
+    is the resolution REPORT for size ``n`` ('xla' | 'squaring' |
+    'blocked-fw' | 'xla-fallback'); other bucket sizes may resolve
+    differently.
     """
     if impl not in ("xla", "pallas", "auto"):
         raise ValueError(f"apsp_impl must be xla|pallas|auto, got '{impl}'")
     if impl == "xla":
         return None, "xla"
+    if impl == "auto":
+        path = auto_apsp_path(n, interpret=interpret)
+        if path == "xla":
+            return None, "xla"
+        return functools.partial(apsp_minplus_auto, interpret=interpret), path
     fn = functools.partial(apsp_minplus_pallas, interpret=interpret)
     return fn, pallas_apsp_path(n, interpret=interpret)
 

@@ -8,7 +8,7 @@ Erdős–Rényi / Poisson-disk network on one TPU chip, with the Pallas min-plus
 APSP kernel carrying the O(N^3) shortest-path work.
 
 Usage:  python scripts/large_scale_demo.py [--n 1000] [--gtype er]
-        [--apsp pallas|xla] [--k 3] [--steps 5]
+        [--apsp pallas|xla|auto] [--k 3] [--steps 5]
 Prints one JSON line with build/compile/step timings and policy metrics.
 """
 
@@ -71,7 +71,7 @@ def main() -> int:
     ap.add_argument("--load", type=float, default=0.15)
     ap.add_argument("--T", type=float, default=1000.0)
     ap.add_argument("--k", type=int, default=3, help="Chebyshev order")
-    ap.add_argument("--apsp", default="pallas", choices=["pallas", "xla"])
+    ap.add_argument("--apsp", default="pallas", choices=["pallas", "xla", "auto"])
     ap.add_argument("--sparse", action="store_true",
                     help="COO segment-sum GNN propagation instead of the "
                          "dense (E, E) support (cuts transfer/memory ~500x)")
@@ -91,7 +91,7 @@ def main() -> int:
     from multihop_offload_tpu.models import make_model
     from multihop_offload_tpu.models.chebconv import chebyshev_support
     from multihop_offload_tpu.ops.minplus import (
-        apsp_minplus_pallas, pallas_apsp_path,
+        apsp_minplus_pallas, resolve_apsp,
     )
 
     rng = np.random.default_rng(args.seed)
@@ -121,9 +121,9 @@ def main() -> int:
         model = model.clone(propagate=coo_propagate)
         support = dense_to_coo(np.asarray(support))
     # report the path actually executed, not just the one requested: the
-    # pallas dispatcher delegates to XLA beyond its validated size range
-    apsp_fn = apsp_minplus_pallas if args.apsp == "pallas" else None
-    apsp_path = pallas_apsp_path(pad.n) if args.apsp == "pallas" else "xla"
+    # pallas dispatcher delegates to XLA beyond its validated size range and
+    # 'auto' follows the measured crossover (benchmarks/pallas_tpu.json)
+    apsp_fn, apsp_path = resolve_apsp(args.apsp, pad.n)
 
     # inst/jobs/support as jit ARGUMENTS, not closure captures — captured
     # arrays are baked into the HLO as literals (hundreds of MB at N=1000)

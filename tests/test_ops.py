@@ -225,6 +225,43 @@ def test_blocked_fw_matches_xla_beyond_squaring_cap():
     assert (np.diag(got) == 0).all()
 
 
+def test_auto_apsp_follows_measured_crossover():
+    """`apsp_impl='auto'` must pick the fastest MEASURED implementation per
+    shape (benchmarks/pallas_tpu.json: XLA wins to padded N=384, blocked FW
+    from 512) — not 'pallas whenever on TPU' (the pre-crossover policy)."""
+    from multihop_offload_tpu.ops.minplus import (
+        apsp_minplus_auto, auto_apsp_path, resolve_apsp,
+    )
+
+    # below the crossover auto = XLA regardless of backend
+    assert auto_apsp_path(110, interpret=True) == "xla"
+    assert auto_apsp_path(384, interpret=True) == "xla"
+    assert auto_apsp_path(512, interpret=True) == "blocked-fw"
+    assert auto_apsp_path(1000, interpret=True) == "blocked-fw"
+    assert auto_apsp_path(3000, interpret=True) == "xla-fallback"
+
+    # resolve_apsp('auto') returns the None sentinel (plain XLA APSP, no
+    # wrapper overhead) below the crossover, the dispatching wrapper above
+    fn, path = resolve_apsp("auto", 110)
+    assert fn is None and path == "xla"
+    fn, path = resolve_apsp("auto", 512, interpret=True)
+    assert fn is not None and path == "blocked-fw"
+    # 'pallas' still forces the kernel at small sizes (proof runs)
+    _, path = resolve_apsp("pallas", 110, interpret=True)
+    assert path == "squaring"
+
+    # numerics through the auto wrapper, both sides of the crossover
+    rng = np.random.default_rng(11)
+    for n in (60, 512):
+        w = _random_symmetric_weights(rng, n, p=4.0 / n)
+        got = np.asarray(
+            apsp_minplus_auto(jnp.asarray(w, jnp.float32), interpret=True)
+        )
+        expect = np.asarray(apsp_minplus(jnp.asarray(w, jnp.float32)))
+        finite = np.isfinite(expect)
+        np.testing.assert_allclose(got[finite], expect[finite], rtol=1e-6)
+
+
 def test_blocked_fw_asymmetric_and_batched():
     """blocked_fw_call is exact FW — no symmetry assumption; batched."""
     from multihop_offload_tpu.ops.minplus import blocked_fw_call
