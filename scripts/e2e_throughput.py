@@ -62,6 +62,10 @@ def _parse_args(argv=None):
     ap.add_argument("--out", default="benchmarks/end_to_end.json")
     ap.add_argument("--no_retry", action="store_true",
                     help="run in-process (no bounded-subprocess harness)")
+    ap.add_argument("--steady", type=int, default=200,
+                    help="after the timed cold run, re-run this many files "
+                         "with warm jit caches and report the steady-state "
+                         "pipeline rate (0 disables)")
     return ap.parse_args(argv)
 
 
@@ -126,9 +130,37 @@ def measure(args) -> int:
                  "jitted program; dividing the sweep rate by the "
                  "reference's GNN-only rate understates our multiple",
     }
-    os.makedirs(os.path.dirname(args.out), exist_ok=True)
-    with open(args.out, "w") as f:
-        json.dump(report, f, indent=2)
+    def write_report():
+        out_parent = os.path.dirname(args.out)
+        if out_parent:
+            os.makedirs(out_parent, exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+
+    # the cold measurement is the primary artifact — persist it BEFORE the
+    # optional steady pass so a backend hang there can't discard it
+    write_report()
+    if args.steady:
+        # same Evaluator, warm in-process jit caches: the pipeline rate a
+        # long-running service sees (the cold number above includes one
+        # XLA compile per pad bucket).  Separate out_dir: Evaluator.run
+        # names its CSV by dataset/load/T only, and the steady pass must
+        # not overwrite the full-sweep CSV with a truncated one.
+        n_steady = min(args.steady, n_files)
+        t0 = time.time()
+        ev.run(files_limit=n_steady, out_dir=cfg.out + "_steady", verbose=False)
+        steady_wall = time.time() - t0
+        steady_rate = n_steady * cfg.num_instances / steady_wall
+        report["steady_state"] = {
+            "instances_per_sec": round(steady_rate, 2),
+            "files": int(n_steady),
+            "wall_seconds": round(steady_wall, 1),
+            "vs_reference_sweep": round(
+                steady_rate / (1.0 / REF_SWEEP_S_PER_INSTANCE), 1
+            ),
+            "notes": "warm jit caches; excludes per-bucket compiles",
+        }
+        write_report()
     print(json.dumps(report, indent=2))
     return 0
 
