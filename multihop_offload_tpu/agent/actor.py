@@ -34,6 +34,26 @@ class ActorOutput:
     lam: jnp.ndarray           # (E,) raw GNN output
 
 
+def default_support(model, inst: Instance) -> jnp.ndarray:
+    """Support matrix when the caller doesn't supply one.
+
+    k=1: the raw extended adjacency — the reference's shipped behavior (it
+    never applies Spektral's `LayerPreprocess`, `gnn_offloading_agent.py:
+    34,148`; with its effective K=1 the support is unused anyway).  k>=2:
+    the masked rescaled Laplacian the Chebyshev recursion is defined over
+    (`models.chebconv.chebyshev_support`).  Round-3 finding: defaulting
+    k>=2 to the raw adjacency left the spectral path so badly scaled that
+    the predicted rates never influenced a single offloading decision in
+    300 training visits — training ran, gradients flowed, policy never
+    moved.  The support must match the model order by default.
+    """
+    if model.k >= 2:
+        from multihop_offload_tpu.models.chebconv import chebyshev_support
+
+        return chebyshev_support(inst.adj_ext, inst.ext_mask)
+    return inst.adj_ext
+
+
 def build_ext_features(inst: Instance, jobs: JobSet) -> jnp.ndarray:
     """(E, 4) features: [self_loop, rate, exogenous arrivals, is_server]
     (`gnn_offloading_agent.py:219-224`; arrivals from `graph_expand`'s

@@ -15,6 +15,7 @@ from multihop_offload_tpu.agent.actor import (
     ActorOutput,
     actor_delay_matrix,
     compat_cycled_diagonal,
+    default_support,
 )
 from multihop_offload_tpu.env.policies import PolicyOutcome, evaluate_spmatrix_policy
 from multihop_offload_tpu.graphs.instance import Instance, JobSet
@@ -36,7 +37,7 @@ def forward_env(
     cycled node-delay diagonal (`compat_cycled_diagonal`) instead of the
     correct scatter — the A/B switch for matching its published numbers."""
     if support is None:
-        support = inst.adj_ext  # reference compat: raw ext adjacency
+        support = default_support(model, inst)
     actor = actor_delay_matrix(model, variables, inst, jobs, support)
     if compat_diagonal_bug:
         unit_diag = compat_cycled_diagonal(inst, actor.node_delay)

@@ -35,6 +35,7 @@ from multihop_offload_tpu.agent.actor import (
     ActorOutput,
     actor_delay_matrix,
     compat_cycled_diagonal,
+    default_support,
     lambdas_to_delay_matrix,
 )
 from multihop_offload_tpu.env.apsp import (
@@ -173,7 +174,7 @@ def forward_backward(
     compat_diagonal_bug: bool = False,
 ) -> TrainStepOutput:
     if support is None:
-        support = inst.adj_ext
+        support = default_support(model, inst)
     apsp = apsp_fn or apsp_minplus
 
     # --- 1. actor forward under VJP -------------------------------------

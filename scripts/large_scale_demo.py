@@ -88,8 +88,8 @@ def main() -> int:
     from multihop_offload_tpu.graphs.instance import (
         PadSpec, build_instance, build_jobset,
     )
+    from multihop_offload_tpu.agent.actor import default_support
     from multihop_offload_tpu.models import make_model
-    from multihop_offload_tpu.models.chebconv import chebyshev_support
     from multihop_offload_tpu.ops.minplus import (
         apsp_minplus_pallas, resolve_apsp,
     )
@@ -111,9 +111,7 @@ def main() -> int:
 
     cfg = Config(cheb_k=args.k, T=int(args.T))
     model = make_model(cfg)
-    support = inst.adj_ext if args.k == 1 else chebyshev_support(
-        inst.adj_ext, inst.ext_mask
-    )
+    support = default_support(model, inst)
     variables = model.init(jax.random.PRNGKey(0), jnp.zeros((pad.e, 4)), support)
     if args.sparse:
         from multihop_offload_tpu.ops import coo_propagate, dense_to_coo

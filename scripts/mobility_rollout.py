@@ -56,8 +56,8 @@ def main() -> int:
         migrate_link_state, random_walk, topology_update,
     )
     from multihop_offload_tpu.graphs.topology import build_topology, sample_link_rates
+    from multihop_offload_tpu.agent.actor import default_support
     from multihop_offload_tpu.models import make_model
-    from multihop_offload_tpu.models.chebconv import chebyshev_support
 
     rng = np.random.default_rng(args.seed)
     adj, pos, _ = generators.connected_poisson_disk(args.n, seed=args.seed)
@@ -104,9 +104,7 @@ def main() -> int:
     for step in range(args.steps):
         inst = build_instance(topo, roles, proc_bws, link_rates, args.T, pad,
                               dtype=cfg.jnp_dtype)
-        support = inst.adj_ext if args.k == 1 else chebyshev_support(
-            inst.adj_ext, inst.ext_mask
-        )
+        support = default_support(model, inst)
         bl, loc, gnn = eval_all(variables, inst, jobs, support,
                                 jax.random.fold_in(key, step))
         mask = np.asarray(jobs.mask)

@@ -82,6 +82,9 @@ def main() -> int:
     ap.add_argument("--num_instances", type=int, default=10)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--cheb_k", type=int, default=1,
+                    help="Chebyshev order: 1 = the reference's effective "
+                         "per-node MLP, >=2 = the real spectral GNN")
     ap.add_argument("--tail_rows", type=int, default=500)
     args = ap.parse_args()
 
@@ -115,6 +118,7 @@ def main() -> int:
         files_limit=files_limit,
         seed=args.seed,
         dtype=args.dtype,
+        cheb_k=args.cheb_k,
     )
     trainer = Trainer(cfg)
     restored = trainer.try_restore()

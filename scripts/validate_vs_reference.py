@@ -83,6 +83,8 @@ def main() -> int:
                     choices=["latest", "best"],
                     help="which orbax tree to restore for --training_set "
                          "models (best = rolling-tau best, training/README)")
+    ap.add_argument("--cheb_k", type=int, default=1,
+                    help="Chebyshev order of the evaluated checkpoint")
     args = ap.parse_args()
     ref_csv = os.path.join(
         REF, "out",
@@ -103,6 +105,7 @@ def main() -> int:
         seed=7,
         compat_diagonal_bug=args.compat_diagonal_bug,
         pad_buckets=args.pad_buckets,
+        cheb_k=args.cheb_k,
     )
     ev = Evaluator(cfg)
     restored = ev.try_restore(which=args.checkpoint)
@@ -126,7 +129,8 @@ def main() -> int:
     ref_agg = aggregates(ref, "Algo")
 
     report = {"ours_csv": csv_path, "reference_csv": ref_csv,
-              "compat_diagonal_bug": args.compat_diagonal_bug, "methods": {}}
+              "compat_diagonal_bug": args.compat_diagonal_bug,
+              "cheb_k": args.cheb_k, "methods": {}}
     print(f"\n{'method':<10} {'metric':<24} {'reference':>12} {'ours':>12} {'rel diff':>9}")
     for algo in ALGO_MAP:
         r, o = ref_agg.get(algo, {}), ours_agg.get(algo, {})
