@@ -46,3 +46,17 @@ def restore_checkpoint(directory: str, abstract_state: Any, step: Optional[int] 
             return None
         target = jax.tree_util.tree_map(np.asarray, abstract_state)
         return mgr.restore(step, args=ocp.args.StandardRestore(target))
+
+
+def restore_checkpoint_raw(directory: str, step: Optional[int] = None):
+    """Template-free restore: the saved tree exactly as written.
+
+    Lets a reader recover `params` from a checkpoint whose OPTIMIZER state
+    structure no longer matches the current config (e.g. a checkpoint
+    trained with an LR-schedule optimizer evaluated by a constant-lr
+    Evaluator) — the strict template restore refuses such trees wholesale.
+    """
+    # None is a zero-leaf pytree: the template path degenerates to exactly
+    # StandardRestore(None), so delegate rather than duplicate the
+    # manager/step-resolution logic
+    return restore_checkpoint(directory, None, step)

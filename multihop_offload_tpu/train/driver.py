@@ -309,9 +309,34 @@ class _Harness:
             "opt_state": self.opt_state,
             "step": 0,
         }
-        restored = ckpt_lib.restore_checkpoint(directory, state, step)
+        try:
+            restored = ckpt_lib.restore_checkpoint(directory, state, step)
+            self.opt_state = restored["opt_state"]
+        except ValueError:
+            # optimizer-state structure mismatch (checkpoint trained under a
+            # different optax chain, e.g. with an LR schedule): recover the
+            # params alone and keep this harness's fresh opt_state — always
+            # sound for evaluation; resumed TRAINING restarts its schedule.
+            # Only a genuine opt_state-only divergence may take this path —
+            # a PARAMS mismatch (wrong cheb_k/width checkpoint) must keep
+            # failing loudly, not surface as a cryptic shape error downstream
+            restored = ckpt_lib.restore_checkpoint_raw(directory, step)
+            cur = self.variables["params"]
+            shape_of = lambda tree: jax.tree_util.tree_map(np.shape, tree)  # noqa: E731
+            try:
+                shapes_match = shape_of(restored["params"]) == shape_of(cur)
+            except Exception:
+                shapes_match = False
+            if not shapes_match:
+                raise
+            # the strict path casts into the template dtype; mirror that
+            restored["params"] = jax.tree_util.tree_map(
+                lambda t, r: np.asarray(r, dtype=np.asarray(t).dtype),
+                cur, restored["params"],
+            )
+            print("checkpoint optimizer state does not match current config; "
+                  "restored params only (fresh optimizer state)")
         self.variables = {"params": restored["params"]}
-        self.opt_state = restored["opt_state"]
         # resumed training continues the visit counter PAST every existing
         # step in the resume chain (not just the restored one — restoring
         # `best` then saving at an id the `orbax` tree already holds would

@@ -73,6 +73,9 @@ def main() -> int:
     ap.add_argument("--critic_weight", type=float, default=1.0,
                     help="1.0 = the reference's analytic-critic recipe")
     ap.add_argument("--mse_weight", type=float, default=0.001)
+    ap.add_argument("--learning_decay", type=float, default=1.0,
+                    help="exponential LR decay per 100 optimizer steps "
+                         "(= per file visit at batch=100); 1.0 = constant")
     ap.add_argument("--learning_rate", type=float, default=1e-6,
                     help="reference bash/train.sh uses 1e-6")
     ap.add_argument("--T", type=int, default=800)
@@ -109,6 +112,7 @@ def main() -> int:
         T=args.T,
         arrival_scale=args.arrival_scale,
         learning_rate=args.learning_rate,
+        learning_decay=args.learning_decay,
         critic_weight=args.critic_weight,
         mse_weight=args.mse_weight,
         batch=args.batch,

@@ -2,6 +2,7 @@
 
 import os
 
+import jax
 import numpy as np
 import pandas as pd
 import pytest
@@ -237,6 +238,33 @@ def test_apsp_impl_knob_plumbs_through_evaluator(tmp_path, tiny_dataset, monkeyp
             ["filename", "Algo", "n_instance"]
         )[cols].reset_index(drop=True)
     pd.testing.assert_frame_equal(dfs["xla"], dfs["pallas"])
+
+
+def test_restore_across_optimizer_structures(tmp_path, tiny_dataset, monkeypatch):
+    """A checkpoint trained under an LR-schedule optimizer (learning_decay
+    < 1 changes the optax state tree) must still evaluate under the default
+    constant-lr config: try_restore falls back to a params-only raw restore
+    instead of refusing the whole tree."""
+    monkeypatch.chdir(tmp_path)
+    cfg = _cfg(tmp_path, tiny_dataset, mesh_data=1, learning_decay=0.95,
+               model_root=str(tmp_path / "m_sched"))
+    tr = Trainer(cfg)
+    tr.run(epochs=2, verbose=False)
+    trained = jax.tree_util.tree_map(np.asarray, tr.variables["params"])
+
+    ev = Evaluator(_cfg(tmp_path, tiny_dataset, mesh_data=1,
+                        model_root=str(tmp_path / "m_sched")))
+    assert ev.cfg.learning_decay == 1.0  # structures genuinely differ
+    assert ev.try_restore() is not None
+    restored = jax.tree_util.tree_map(np.asarray, ev.variables["params"])
+    jax.tree_util.tree_map(np.testing.assert_array_equal, trained, restored)
+
+    # a PARAMS mismatch (wrong model order) must keep failing loudly — the
+    # fallback is for opt_state-only divergence
+    ev2 = Evaluator(_cfg(tmp_path, tiny_dataset, mesh_data=1, cheb_k=2,
+                         model_root=str(tmp_path / "m_sched")))
+    with pytest.raises(ValueError):
+        ev2.try_restore()
 
 
 def test_best_checkpoint_tracking(tmp_path, tiny_dataset, monkeypatch):
