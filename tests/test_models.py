@@ -87,8 +87,14 @@ def test_chebnet_forward_matches_manual_stack(rng):
     assert out.shape == (20, 1)
 
 
-def test_import_reference_checkpoint():
-    variables = load_reference_checkpoint(REFERENCE_CKPT, dtype=np.float64)
+@pytest.mark.parametrize("ckpt", [
+    REFERENCE_CKPT,                                           # BAT800 (T=800)
+    REFERENCE_CKPT.replace("BAT800", "BAT950"),               # BAT950 (T=950)
+])
+def test_import_reference_checkpoint(ckpt):
+    """BOTH shipped reference checkpoints import (`/root/reference/model/`,
+    SURVEY.md §2 #10)."""
+    variables = load_reference_checkpoint(ckpt, dtype=np.float64)
     p = variables["params"]
     assert sorted(p.keys()) == [f"cheb_{i}" for i in range(5)]
     assert p["cheb_0"]["kernel"].shape == (1, 4, 32)
