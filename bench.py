@@ -145,20 +145,26 @@ def measure():
         # scripts/e2e_throughput.py and committed under benchmarks/.
         "scope": "jitted forward_backward step rate, device-resident batch",
     }
-    e2e_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "benchmarks", "end_to_end.json")
-    if os.path.isfile(e2e_path):
+    bench_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "benchmarks")
+    # embed the committed end-to-end record: TPU artifact when present,
+    # else the CPU sweep (its own platform field keeps the label honest)
+    for name in ("end_to_end.json", "end_to_end_cpu.json"):
+        e2e_path = os.path.join(bench_dir, name)
+        if not os.path.isfile(e2e_path):
+            continue
         try:
             with open(e2e_path) as f:
                 e2e = json.load(f)
-            rec["end_to_end"] = {
-                "instances_per_sec": e2e.get("value"),
-                "vs_reference_sweep": e2e.get("vs_reference_sweep"),
-                "platform": e2e.get("platform"),
-                "source": "benchmarks/end_to_end.json",
-            }
         except (OSError, ValueError):
-            pass
+            continue
+        rec["end_to_end"] = {
+            "instances_per_sec": e2e.get("value"),
+            "vs_reference_sweep": e2e.get("vs_reference_sweep"),
+            "platform": e2e.get("platform"),
+            "source": f"benchmarks/{name}",
+        }
+        break
     print(json.dumps(rec))
 
 
