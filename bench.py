@@ -156,7 +156,9 @@ def measure():
         try:
             with open(e2e_path) as f:
                 e2e = json.load(f)
-        except (OSError, ValueError):
+        except (OSError, ValueError) as exc:
+            # fall through, but don't hide a corrupt committed artifact
+            print(f"warning: unreadable {e2e_path}: {exc}", file=sys.stderr)
             continue
         rec["end_to_end"] = {
             "instances_per_sec": e2e.get("value"),

@@ -60,9 +60,10 @@ def main() -> int:
     ap.add_argument("--out", default="out",
                     help="scratch dir for the Evaluator's CSV (gitignored)")
     ap.add_argument("--record", default=None,
-                    help="directory of record for the validation JSON "
-                         "(default: <repo>/validation when run in-repo, "
-                         "else --out)")
+                    help="where to write the validation JSON: a directory "
+                         "(auto-named file inside it) or a path ending in "
+                         ".json (used verbatim).  Default: "
+                         "<repo>/validation when run in-repo, else --out")
     ap.add_argument("--scale", type=float, default=0.15,
                     help="arrival load scale; the reference shipped runs at "
                          "0.15 and 0.20")
@@ -148,10 +149,21 @@ def main() -> int:
     suffix = "_compat" if args.compat_diagonal_bug else ""
     if args.training_set != "BAT800":
         suffix += f"_{args.training_set}"
-    path = os.path.join(
-        record, f"validation_vs_reference_load_{args.scale:.2f}{suffix}.json"
-    )
+    if record.endswith(".json"):
+        # a file path was given — honor it (a .json 'directory' would
+        # silently nest the report inside a dir named like a file)
+        path = record
+        record = os.path.dirname(record) or "."
+    else:
+        path = os.path.join(
+            record, f"validation_vs_reference_load_{args.scale:.2f}{suffix}.json"
+        )
     os.makedirs(record, exist_ok=True)
+    if os.path.isdir(path):
+        print(f"ERROR: {path} is a directory (stale artifact of a pre-fix "
+              f"run?) — remove it or pass a different --record",
+              file=sys.stderr)
+        return 2
     with open(path, "w") as f:
         json.dump(report, f, indent=2)
     print(f"\nwrote {path}")
