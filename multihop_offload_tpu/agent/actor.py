@@ -72,16 +72,20 @@ def build_ext_features(inst: Instance, jobs: JobSet) -> jnp.ndarray:
     )
 
 
-def lambdas_to_delay_matrix(inst: Instance, lam: jnp.ndarray) -> ActorOutput:
+def lambdas_to_delay_matrix(
+    inst: Instance, lam: jnp.ndarray, fp_fn=None
+) -> ActorOutput:
     """Differentiable head: lambda (E,) -> delay matrix
-    (`gnn_offloading_agent.py:229-276`)."""
+    (`gnn_offloading_agent.py:229-276`).  `fp_fn` overrides the fixed-point
+    core (the `fp_impl` knob; Pallas kernel carries a custom_vjp so this
+    stays differentiable either way)."""
     num_links = inst.num_pad_links
     n = inst.num_pad_nodes
     lam = lam * inst.ext_mask  # padded slots predict nothing
     link_lambda = lam[:num_links]
     node_lambda = jnp.where(inst.comp_mask, lam[num_links:], 0.0)
 
-    link_mu = interference_fixed_point(inst, link_lambda)
+    link_mu = interference_fixed_point(inst, link_lambda, fp_fn=fp_fn)
     # link unit delay 1/(mu-lambda); congested (lambda-mu > 0, strict — the
     # empirical evaluator uses >=, a reference asymmetry we keep) replaced by
     # T*lambda/(101*mu)  (`:245-253`)
@@ -147,10 +151,11 @@ def actor_delay_matrix(
     support: jnp.ndarray,
     deterministic: bool = True,
     dropout_rng: jax.Array | None = None,
+    fp_fn=None,
 ) -> ActorOutput:
     feats = build_ext_features(inst, jobs)
     rngs = {"dropout": dropout_rng} if dropout_rng is not None else None
     lam = model.apply(
         variables, feats, support, deterministic=deterministic, rngs=rngs
     )[:, 0]
-    return lambdas_to_delay_matrix(inst, lam)
+    return lambdas_to_delay_matrix(inst, lam, fp_fn=fp_fn)

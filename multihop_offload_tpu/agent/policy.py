@@ -31,6 +31,7 @@ def forward_env(
     explore=0.0,
     prob: bool = False,
     apsp_fn=None,
+    fp_fn=None,
     compat_diagonal_bug: bool = False,
 ) -> tuple[PolicyOutcome, ActorOutput]:
     """`compat_diagonal_bug=True` feeds the decision path the reference's
@@ -38,13 +39,13 @@ def forward_env(
     correct scatter — the A/B switch for matching its published numbers."""
     if support is None:
         support = default_support(model, inst)
-    actor = actor_delay_matrix(model, variables, inst, jobs, support)
+    actor = actor_delay_matrix(model, variables, inst, jobs, support, fp_fn=fp_fn)
     if compat_diagonal_bug:
         unit_diag = compat_cycled_diagonal(inst, actor.node_delay)
     else:
         unit_diag = jnp.diagonal(actor.delay_matrix)
     outcome = evaluate_spmatrix_policy(
         inst, jobs, actor.link_delay, unit_diag, key,
-        explore=explore, prob=prob, apsp_fn=apsp_fn,
+        explore=explore, prob=prob, apsp_fn=apsp_fn, fp_fn=fp_fn,
     )
     return outcome, actor

@@ -65,7 +65,7 @@ class TrainStepOutput:
 
 
 def _critic_loss(
-    inst: Instance, jobs: JobSet, routes_inc: jnp.ndarray
+    inst: Instance, jobs: JobSet, routes_inc: jnp.ndarray, fp_fn=None
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Analytic congestion-model delay of fixed routes
     (`gnn_offloading_agent.py:333-374`).  Returns (loss, unit_edge)."""
@@ -74,7 +74,7 @@ def _critic_loss(
     link_lambda = load[:num_links]
     node_lambda = jnp.where(inst.comp_mask, load[num_links:], 0.0)
 
-    link_mu = interference_fixed_point(inst, link_lambda)
+    link_mu = interference_fixed_point(inst, link_lambda, fp_fn=fp_fn)
     l_cong = (link_lambda - link_mu) > 0
     link_delay = jnp.where(
         l_cong,
@@ -170,6 +170,7 @@ def forward_backward(
     mse_weight: float = 0.001,
     critic_weight: float = 1.0,
     apsp_fn=None,
+    fp_fn=None,
     dropout_rng: jax.Array | None = None,
     compat_diagonal_bug: bool = False,
 ) -> TrainStepOutput:
@@ -185,6 +186,7 @@ def forward_backward(
         out = actor_delay_matrix(
             model, params_tree, inst, jobs, support,
             deterministic=dropout_rng is None, dropout_rng=dropout_rng,
+            fp_fn=fp_fn,
         )
         return out.delay_matrix, out
 
@@ -207,11 +209,11 @@ def forward_backward(
     # (the reference recomputes Dijkstra hops per call, `:304-305`)
     dec = offload_decide(inst, jobs, sp, inst.hop, unit_diag, key, explore, prob)
     routes = trace_routes(inst, next_hop_table(inst.adj, sp), jobs, dec.dst)
-    delays = run_empirical(inst, jobs, routes)
+    delays = run_empirical(inst, jobs, routes, fp_fn=fp_fn)
 
     # --- 3. critic gradient w.r.t. routes -------------------------------
     (loss_critic, unit_edge), grad_routes = jax.value_and_grad(
-        lambda r: _critic_loss(inst, jobs, r), has_aux=True
+        lambda r: _critic_loss(inst, jobs, r, fp_fn=fp_fn), has_aux=True
     )(routes.inc_ext)
 
     # --- 4. suffix-bias gradient onto unit delays -----------------------

@@ -73,6 +73,14 @@ class Config:
     #                                pallas forces the kernel (XLA fallback
     #                                off-TPU or beyond size caps).  See
     #                                ops.minplus.resolve_apsp.
+    fp_impl: str = "auto"          # interference-fixed-point kernel for the
+    #                                actor / critic / empirical evaluator:
+    #                                xla | pallas | auto.  auto = the Pallas
+    #                                VMEM-resident kernel where its on-chip
+    #                                win is measured (padded L<=256: 2.44x,
+    #                                benchmarks/pallas_tpu.json), XLA scan
+    #                                elsewhere and off-TPU.  See
+    #                                ops.fixed_point.resolve_fixed_point.
     compat_diagonal_bug: bool = False  # reproduce the reference's cycled
     #                                decision-path diagonal (A/B validation;
     #                                see agent.actor.compat_cycled_diagonal)

@@ -45,6 +45,7 @@ def evaluate_spmatrix_policy(
     explore=0.0,
     prob: bool = False,
     apsp_fn=None,
+    fp_fn=None,
 ) -> PolicyOutcome:
     """Offload + route + run given per-link unit delays and a node diagonal.
 
@@ -62,22 +63,23 @@ def evaluate_spmatrix_policy(
     dec = offload_decide(inst, jobs, sp, inst.hop, unit_diag, key, explore, prob)
     nh = next_hop_table(inst.adj, sp)
     routes = trace_routes(inst, nh, jobs, dec.dst)
-    delays = run_empirical(inst, jobs, routes)
+    delays = run_empirical(inst, jobs, routes, fp_fn=fp_fn)
     return PolicyOutcome(decision=dec, routes=routes, delays=delays)
 
 
 def baseline_policy(
     inst: Instance, jobs: JobSet, key: jax.Array, explore=0.0, prob: bool = False,
-    apsp_fn=None,
+    apsp_fn=None, fp_fn=None,
 ) -> PolicyOutcome:
     """Congestion-agnostic greedy offloading (`AdHoc_train.py:128-141`)."""
     link_d, node_d = baseline_unit_delays(inst)
     return evaluate_spmatrix_policy(
-        inst, jobs, link_d, node_d, key, explore, prob, apsp_fn=apsp_fn
+        inst, jobs, link_d, node_d, key, explore, prob, apsp_fn=apsp_fn,
+        fp_fn=fp_fn,
     )
 
 
-def local_policy(inst: Instance, jobs: JobSet) -> PolicyOutcome:
+def local_policy(inst: Instance, jobs: JobSet, fp_fn=None) -> PolicyOutcome:
     """Everything computes at its source (`local_compute`,
     `offloading_v3.py:363-386`)."""
     _, node_d = baseline_unit_delays(inst)
@@ -101,5 +103,5 @@ def local_policy(inst: Instance, jobs: JobSet) -> PolicyOutcome:
             jobs.mask.astype(node_d.dtype)
         ),
     )
-    delays = run_empirical(inst, jobs, routes)
+    delays = run_empirical(inst, jobs, routes, fp_fn=fp_fn)
     return PolicyOutcome(decision=dec, routes=routes, delays=delays)

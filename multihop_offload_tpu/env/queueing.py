@@ -66,22 +66,25 @@ def interference_fixed_point_raw(
 
 
 def interference_fixed_point(
-    inst: Instance, link_lambda: jnp.ndarray, num_iters: int = 10
+    inst: Instance, link_lambda: jnp.ndarray, num_iters: int = 10, fp_fn=None
 ) -> jnp.ndarray:
     """Converged per-link service rates mu under conflict coupling.
 
     mu_0 = rate / (cf_deg + 1); iterate: busy = clip(lambda/mu, 0, 1),
     mu = rate / (1 + A_conflict @ busy)   (`offloading_v3.py:500-506`).
     Shared by the empirical evaluator and both differentiable critics
-    (`gnn_offloading_agent.py:240-244`, `:348-352`).
+    (`gnn_offloading_agent.py:240-244`, `:348-352`).  `fp_fn` overrides the
+    XLA scan with a drop-in core (the `fp_impl` knob resolves to the Pallas
+    VMEM-resident kernel, `ops.fixed_point.resolve_fixed_point`).
     """
-    return interference_fixed_point_raw(
+    fp = fp_fn or interference_fixed_point_raw
+    return fp(
         inst.adj_conflict, inst.link_rates, inst.cf_degs, link_lambda, num_iters
     )
 
 
 def run_empirical(
-    inst: Instance, jobs: JobSet, routes: RouteSet
+    inst: Instance, jobs: JobSet, routes: RouteSet, fp_fn=None
 ) -> EmpiricalDelays:
     num_links = inst.num_pad_links
     n = inst.num_pad_nodes
@@ -95,7 +98,7 @@ def run_empirical(
         jnp.where(jmask, ul_rate, 0.0)
     )                                             # (`:496`)
 
-    link_mu = interference_fixed_point(inst, link_lambda)
+    link_mu = interference_fixed_point(inst, link_lambda, fp_fn=fp_fn)
 
     # per-(link, job) unit delay with per-job congestion fallback (`:537-539`)
     slack = link_mu - link_lambda                 # (L,)

@@ -79,6 +79,49 @@ def fixed_point_path(interpret: bool = False) -> str:
     return "pallas" if tpu_backend() else "xla-fallback"
 
 
+# Measured crossover (`benchmarks/pallas_tpu.json`): the VMEM-resident kernel
+# wins 2.44x at padded L=256 (the production bench batch shape) and ties XLA
+# at L=512.  'auto' takes Pallas only where a WIN is measured; unmeasured
+# shapes (384) and the tie default to XLA.
+_AUTO_FP_MAX_L = 256
+
+
+def auto_fp_path(l: int, interpret: bool = False) -> str:
+    """Path `fp_impl='auto'` takes for padded link count l: 'pallas' where the
+    kernel's on-chip win is measured, 'xla' elsewhere (incl. off-TPU)."""
+    l_pad = max(_LANE, math.ceil(l / _LANE) * _LANE)
+    if l_pad > _AUTO_FP_MAX_L:
+        return "xla"
+    return fixed_point_path(interpret=interpret)
+
+
+def resolve_fixed_point(impl: str, l: int, interpret: bool = False):
+    """Resolve the config knob `fp_impl` to a fixed-point callable.
+
+    Mirrors `minplus.resolve_apsp`: returns ``(fp_fn, path)`` where ``fp_fn``
+    is None for the default XLA scan (callers treat None as
+    `env.queueing.interference_fixed_point_raw`) and otherwise a drop-in
+    ``(adj, rates, cf, lam, num_iters) -> mu`` running the Pallas kernel.
+    ``path`` reports the resolution for padded link count ``l``
+    ('xla' | 'pallas' | 'xla-fallback').
+    """
+    if impl not in ("xla", "pallas", "auto"):
+        raise ValueError(f"fp_impl must be xla|pallas|auto, got '{impl}'")
+    if impl == "xla":
+        return None, "xla"
+
+    def fn(adj, rates, cf, lam, num_iters=10):
+        return fixed_point_pallas(adj, rates, cf, lam, num_iters, interpret)
+
+    if impl == "auto":
+        path = auto_fp_path(l, interpret=interpret)
+        if path in ("xla", "xla-fallback"):
+            # None sentinel = direct XLA execution, no wrapper indirection
+            return None, path
+        return fn, path
+    return fn, fixed_point_path(interpret=interpret)
+
+
 def _xla_reference(adj, rates, cf, lam, num_iters):
     # the one true update lives in env.queueing; the VJP recompute must pull
     # back through exactly the math the rest of the framework runs

@@ -315,8 +315,11 @@ def resolve_apsp(impl: str, n: int, interpret: bool = False):
         return None, "xla"
     if impl == "auto":
         path = auto_apsp_path(n, interpret=interpret)
-        if path == "xla":
-            return None, "xla"
+        if path in ("xla", "xla-fallback"):
+            # None is the sentinel for direct XLA execution; huge-N (or
+            # off-TPU) 'auto' callers must not take the wrapper->pallas->
+            # XLA-fallback indirection.
+            return None, path
         return functools.partial(apsp_minplus_auto, interpret=interpret), path
     fn = functools.partial(apsp_minplus_pallas, interpret=interpret)
     return fn, pallas_apsp_path(n, interpret=interpret)

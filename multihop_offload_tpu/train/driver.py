@@ -185,6 +185,12 @@ class _Harness:
         from multihop_offload_tpu.ops.minplus import resolve_apsp
 
         apsp_fn, self.apsp_path = resolve_apsp(self.cfg.apsp_impl, self.data.pad.n)
+        # interference-fixed-point kernel (`fp_impl` knob), resolved the same
+        # way: None -> the XLA scan, else the Pallas VMEM-resident kernel
+        # (custom_vjp, so both critics differentiate through it unchanged)
+        from multihop_offload_tpu.ops.fixed_point import resolve_fixed_point
+
+        fp_fn, self.fp_path = resolve_fixed_point(self.cfg.fp_impl, self.data.pad.l)
 
         def gnn_train_step(variables, mem, inst, jobsets, keys, explore):
             """vmapped forward_backward + in-program gradient memorization."""
@@ -197,7 +203,7 @@ class _Harness:
                                         dropout_rng=dk,
                                         critic_weight=critic_w,
                                         mse_weight=mse_w,
-                                        apsp_fn=apsp_fn,
+                                        apsp_fn=apsp_fn, fp_fn=fp_fn,
                                         compat_diagonal_bug=compat_diag)
 
             outs = jax.vmap(one, in_axes=(0, 0))(jobsets, keys)
@@ -216,13 +222,17 @@ class _Harness:
             The ONE definition of the method triple — every single-device
             and sharded variant below wraps this same closure."""
             bl = jax.vmap(
-                lambda jb, k: baseline_policy(inst, jb, k, apsp_fn=apsp_fn).job_total
+                lambda jb, k: baseline_policy(
+                    inst, jb, k, apsp_fn=apsp_fn, fp_fn=fp_fn
+                ).job_total
             )(jobsets, keys)
-            loc = jax.vmap(lambda jb: local_policy(inst, jb).job_total)(jobsets)
+            loc = jax.vmap(
+                lambda jb: local_policy(inst, jb, fp_fn=fp_fn).job_total
+            )(jobsets)
             gnn = jax.vmap(
                 lambda jb, k: forward_env(
                     model, variables, inst, jb, k, prob=prob, apsp_fn=apsp_fn,
-                    compat_diagonal_bug=compat_diag,
+                    fp_fn=fp_fn, compat_diagonal_bug=compat_diag,
                 )[0].job_total
             )(jobsets, keys)
             return bl, loc, gnn
@@ -235,10 +245,10 @@ class _Harness:
         )
         if self.mesh is not None:
             self._build_dp_steps(model, prob, use_dropout, critic_w, mse_w,
-                                 compat_diag, apsp_fn, eval_methods)
+                                 compat_diag, apsp_fn, fp_fn, eval_methods)
 
     def _build_dp_steps(self, model, prob, use_dropout, critic_w, mse_w,
-                        compat_diag, apsp_fn, eval_methods):
+                        compat_diag, apsp_fn, fp_fn, eval_methods):
         """shard_map variants over the 'data' mesh axis (new capability vs the
         single-device reference, SURVEY.md §2.8): the Trainer shards the
         per-file episode batch, the Evaluator shards whole files.  Episode
@@ -254,7 +264,7 @@ class _Harness:
         self._gnn_train_step_dp = make_file_dp_train_step(
             model, mesh, dropout=use_dropout, prob=prob,
             critic_weight=critic_w, mse_weight=mse_w, apsp_fn=apsp_fn,
-            compat_diagonal_bug=compat_diag,
+            fp_fn=fp_fn, compat_diagonal_bug=compat_diag,
         )
         self._eval_methods_dp = make_sharded_eval_step(eval_methods, mesh)
         self._eval_files_dp = make_files_eval_step(eval_methods, mesh)
@@ -311,7 +321,6 @@ class _Harness:
         }
         try:
             restored = ckpt_lib.restore_checkpoint(directory, state, step)
-            self.opt_state = restored["opt_state"]
         except ValueError:
             # optimizer-state structure mismatch (checkpoint trained under a
             # different optax chain, e.g. with an LR schedule): recover the
@@ -322,20 +331,33 @@ class _Harness:
             # failing loudly, not surface as a cryptic shape error downstream
             restored = ckpt_lib.restore_checkpoint_raw(directory, step)
             cur = self.variables["params"]
-            shape_of = lambda tree: jax.tree_util.tree_map(np.shape, tree)  # noqa: E731
+            # compare keyed leaf paths + shapes, not container == container:
+            # orbax may restore plain dicts where the live tree is a flax
+            # FrozenDict, and that must not refuse a valid params restore
+            def _leaf_shapes(tree):
+                flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+                return [(jax.tree_util.keystr(p), np.shape(x)) for p, x in flat]
+
             try:
-                shapes_match = shape_of(restored["params"]) == shape_of(cur)
+                shapes_match = _leaf_shapes(restored["params"]) == _leaf_shapes(cur)
             except Exception:
                 shapes_match = False
             if not shapes_match:
                 raise
-            # the strict path casts into the template dtype; mirror that
+            # rebuild in the live tree's container types, then cast into the
+            # template dtype the way the strict path does
+            leaves = jax.tree_util.tree_leaves(restored["params"])
+            rebuilt = jax.tree_util.tree_unflatten(
+                jax.tree_util.tree_structure(cur), leaves
+            )
             restored["params"] = jax.tree_util.tree_map(
                 lambda t, r: np.asarray(r, dtype=np.asarray(t).dtype),
-                cur, restored["params"],
+                cur, rebuilt,
             )
             print("checkpoint optimizer state does not match current config; "
                   "restored params only (fresh optimizer state)")
+        else:
+            self.opt_state = restored["opt_state"]
         self.variables = {"params": restored["params"]}
         # resumed training continues the visit counter PAST every existing
         # step in the resume chain (not just the restored one — restoring

@@ -306,3 +306,78 @@ def test_fixed_point_off_tpu_fallback_matches_reference(small_cases, rng):
     out = fixed_point_pallas(adj, rates, cf, lam, 10, False)
     ref = _xla_reference(adj, rates, cf, lam, 10)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-6)
+
+
+def test_resolve_fixed_point_paths():
+    """`fp_impl` knob resolution mirrors `resolve_apsp`: None is the sentinel
+    for direct XLA execution (incl. off-TPU fallback and beyond the measured
+    crossover); interpret mode yields a real Pallas callable."""
+    from multihop_offload_tpu.ops.fixed_point import resolve_fixed_point
+
+    fn, path = resolve_fixed_point("xla", 256)
+    assert fn is None and path == "xla"
+    # beyond the measured win (L=512 ties XLA on chip): direct XLA
+    fn, path = resolve_fixed_point("auto", 512)
+    assert fn is None and path == "xla"
+    # inside the measured win but suite runs on CPU: direct XLA, honest path
+    fn, path = resolve_fixed_point("auto", 200)
+    assert fn is None and path == "xla-fallback"
+    fn, path = resolve_fixed_point("auto", 200, interpret=True)
+    assert fn is not None and path == "pallas"
+    import pytest
+
+    with pytest.raises(ValueError):
+        resolve_fixed_point("bogus", 128)
+
+
+def test_forward_backward_invariant_to_fp_impl():
+    """Training math must be invariant to the fixed-point kernel choice:
+    `fp_fn` (interpret-mode Pallas, custom_vjp) == default XLA scan for
+    values AND parameter gradients."""
+    import jax
+
+    from multihop_offload_tpu.agent.train_step import forward_backward
+    from multihop_offload_tpu.config import Config
+    from multihop_offload_tpu.graphs import generators
+    from multihop_offload_tpu.graphs.instance import (
+        PadSpec, build_instance, build_jobset,
+    )
+    from multihop_offload_tpu.graphs.topology import (
+        build_topology, sample_link_rates,
+    )
+    from multihop_offload_tpu.models import make_model
+    from multihop_offload_tpu.ops.fixed_point import resolve_fixed_point
+
+    rng = np.random.default_rng(7)
+    adj, _ = generators.generate("er", 24, seed=8)
+    topo = build_topology(adj)
+    roles = np.zeros(24, dtype=np.int32)
+    roles[[2, 9]] = 1
+    bws = np.where(roles == 1, 80.0, 4.0)
+    rates = sample_link_rates(topo, 50.0, rng=rng)
+    pad = PadSpec(n=24, l=PadSpec.round_up(topo.num_links, 8), s=8, j=8)
+    inst = build_instance(topo, roles, bws, rates, 1000.0, pad, dtype=np.float64)
+    mobile = np.flatnonzero(roles == 0)
+    jobs = build_jobset(mobile[:6], 0.15 * rng.uniform(0.1, 0.5, 6), pad_jobs=8,
+                        dtype=np.float64)
+
+    cfg = Config(dtype="float64")
+    model = make_model(cfg)
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((pad.e, 4), jnp.float64), inst.adj_ext
+    )
+    key = jax.random.PRNGKey(4)
+    fp_fn, path = resolve_fixed_point("pallas", pad.l, interpret=True)
+    assert path == "pallas"
+    out_xla = forward_backward(model, variables, inst, jobs, key)
+    out_pl = forward_backward(model, variables, inst, jobs, key, fp_fn=fp_fn)
+    np.testing.assert_array_equal(np.asarray(out_xla.dst), np.asarray(out_pl.dst))
+    np.testing.assert_allclose(
+        np.asarray(out_xla.delays.job_total), np.asarray(out_pl.delays.job_total),
+        rtol=1e-9,
+    )
+    flat_x = jax.tree_util.tree_leaves(out_xla.grads)
+    flat_p = jax.tree_util.tree_leaves(out_pl.grads)
+    for gx, gp in zip(flat_x, flat_p):
+        np.testing.assert_allclose(np.asarray(gx), np.asarray(gp),
+                                   rtol=1e-7, atol=1e-10)
