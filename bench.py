@@ -85,38 +85,35 @@ def _hand_flop_count(pad_n, pad_l, pad_e, batch, cheb_k=1, layers=5, hidden=32,
     """Analytic FLOPs/step sanity check for the cost-analysis number.
 
     Per episode: APSP min-plus squaring ~ ceil(log2 N) iterations of an
-    (N,N,N) add+min => 2N^3 per iteration; interference fixed point appears
-    three times (actor, critic fwd+bwd recompute, empirical run) ~ 4 paths x
-    fp_iters x 2L^2 matvec; ChebConv layers: per layer K support matmuls
-    (E,E)@(E,F) = 2E^2F, forward + ~2x backward.  Defaults mirror the bench
-    model (the reference checkpoint's effective K=1 ChebNet, 5x32).
+    (N,N,N) add+min => 2N^3 per iteration; the interference fixed point
+    executes ~5 unrolled passes (actor fwd, actor VJP bwd, critic
+    value_and_grad fwd+bwd, empirical run) x fp_iters x 2L^2 matvec;
+    ChebConv layers: per layer K support matmuls (E,E)@(E,F) = 2E^2F,
+    forward + ~2x backward.  Defaults mirror the bench model (the reference
+    checkpoint's effective K=1 ChebNet, 5x32).
     """
     import math
 
     apsp = 2 * pad_n**3 * math.ceil(math.log2(max(pad_n, 2)))
-    fp = 4 * fp_iters * 2 * pad_l**2
+    fp = 5 * fp_iters * 2 * pad_l**2
     width = [4] + [hidden] * (layers - 1) + [1]
     cheb = sum(cheb_k * 2 * pad_e**2 * f for f in width[:-1])
     return batch * (apsp + fp + 3 * cheb)
 
 
-def measure():
-    """The actual benchmark; prints the JSON line.  Runs in the child."""
-    from multihop_offload_tpu.utils.platform import apply_platform_env
-
-    apply_platform_env()
-
+def build_bench_batch():
+    """The bench workload, shared with `scripts/profile_breakdown.py`:
+    real reference test networks, the reference's shipped checkpoint, the
+    shapes the published numbers ran at.  Returns
+    (model, variables, binst, bjobs, pad, batch)."""
     import jax
     import jax.numpy as jnp
 
-    from multihop_offload_tpu.agent import forward_backward
     from multihop_offload_tpu.graphs.instance import (
         PadSpec, build_instance, build_jobset, stack_instances,
     )
     from multihop_offload_tpu.graphs.topology import sample_link_rates
     from multihop_offload_tpu.models import ChebNet, load_reference_checkpoint
-
-    platform = jax.default_backend()
 
     num_networks = int(os.environ.get("BENCH_NETWORKS", 16))
     per_network = int(os.environ.get("BENCH_INSTANCES", 4))
@@ -153,6 +150,22 @@ def measure():
             jnp.zeros((pad.e, 4), jnp.float32),
             jnp.zeros((pad.e, pad.e), jnp.float32),
         )
+    return model, variables, binst, bjobs, pad, batch
+
+
+def measure():
+    """The actual benchmark; prints the JSON line.  Runs in the child."""
+    from multihop_offload_tpu.utils.platform import apply_platform_env
+
+    apply_platform_env()
+
+    import jax
+    import jax.numpy as jnp  # noqa: F401
+
+    from multihop_offload_tpu.agent import forward_backward
+
+    platform = jax.default_backend()
+    model, variables, binst, bjobs, pad, batch = build_bench_batch()
 
     # kernel knobs, resolved exactly as the drivers do (None = XLA); the
     # env overrides are the on-chip A/B switch for the Pallas kernels
@@ -241,7 +254,7 @@ def measure():
             "note": "flops from XLA cost_analysis on the compiled step "
                     "(fwd+bwd, whole batch); peak is the chip's published "
                     "dense-matmul bf16 number; hand count: "
-                    "APSP 2N^3 ceil(log2 N) + 4x fixed-point 2L^2 x10 + "
+                    "APSP 2N^3 ceil(log2 N) + 5x fixed-point 2L^2 x10 + "
                     "3x ChebConv K*2E^2F terms",
         },
         # vs_baseline compares our jitted step rate (device-resident batch)

@@ -40,20 +40,36 @@ from jax.experimental.pallas import tpu as pltpu
 _LANE = 128
 
 
+_ROW_CHUNK = 8  # f32 sublane count: rows extracted one sublane group at a time
+
+
 def _apsp_kernel(d_ref, o_ref, *, n: int, iters: int):
     d = d_ref[0]
-    # row index as an iota comparison: Mosaic has no dynamic_slice on a value
-    # held in registers, so row k is extracted with a masked min-reduce
-    # (inert +inf elsewhere) — static ops only, same O(N^2) as the update
-    row_ids = lax.broadcasted_iota(jnp.int32, (n, 1), 0)
+    # Mosaic has no dynamic_slice on a value held in registers, so pivot rows
+    # are extracted with masked min-reduces (inert +inf elsewhere).  Doing
+    # that per pivot costs O(N^2) VPU work per row — as much as the update
+    # itself (round-3 verdict: the kernel lost to XLA below N=512 mostly on
+    # this).  Min-plus SQUARING has independent pivots (unlike FW), so rows
+    # are pulled a SUBLANE GROUP at a time: one masked reduce yields 8 rows
+    # (O(N^2) per chunk, O(N^3/8) total), then a static 8-way unroll of
+    # cheap register slices does the outer updates.
+    c = _ROW_CHUNK
+    nchunks = n // c
+    chunk_ids = lax.broadcasted_iota(jnp.int32, (nchunks, 1, 1), 0)
 
     def squaring(_, dist):
-        def body(k, acc):
-            masked = jnp.where(row_ids == k, dist, jnp.inf)
-            row = jnp.min(masked, axis=0, keepdims=True)     # (1, N) = dist[k]
-            return jnp.minimum(acc, row.T + row)
+        dist_r = dist.reshape(nchunks, c, n)
 
-        return lax.fori_loop(0, n, body, dist)
+        def chunk_body(q, acc):
+            rows = jnp.min(
+                jnp.where(chunk_ids == q, dist_r, jnp.inf), axis=0
+            )                                   # (c, N) = dist[qc:(q+1)c]
+            cols = rows.T                       # (N, c): symmetric matrix
+            for j in range(c):                  # static unroll, register slices
+                acc = jnp.minimum(acc, cols[:, j:j + 1] + rows[j:j + 1, :])
+            return acc
+
+        return lax.fori_loop(0, nchunks, chunk_body, dist)
 
     o_ref[0] = lax.fori_loop(0, iters, squaring, d)
 

@@ -1,0 +1,79 @@
+"""fp_impl A/B on the bench step — VERDICT r3 item 3's measurement.
+
+Runs `bench.py` twice (BENCH_FP_IMPL=xla then =auto, everything else
+identical) and writes `benchmarks/fp_ab.json` with both JSON lines and the
+step-rate ratio.  bench.py already wraps each run in its bounded-subprocess
+retry harness, so a wedged chip degrades to a labeled CPU fallback rather
+than a hang; the artifact keeps each run's `platform` and `fp_path` so a
+mixed-platform A/B is self-evident (and discarded).
+
+Usage: python scripts/fp_ab.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "benchmarks", "fp_ab.json")
+
+
+def run_bench(fp_impl: str):
+    env = dict(os.environ, BENCH_FP_IMPL=fp_impl)
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, capture_output=True, text=True, cwd=REPO,
+    )
+    for line in reversed(res.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{") and line.endswith("}"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return {"error": f"rc={res.returncode}: "
+            + " | ".join((res.stderr or res.stdout).strip().splitlines()[-3:])}
+
+
+def main() -> int:
+    xla = run_bench("xla")
+    auto = run_bench("auto")
+    rec = {
+        "description": "bench.py step rate with the interference fixed point "
+                       "forced to the XLA scan vs fp_impl=auto (the Pallas "
+                       "VMEM kernel at its measured-win shapes). Valid only "
+                       "when both runs share a platform.",
+        "xla": xla,
+        "auto": auto,
+    }
+    vx, va = xla.get("value"), auto.get("value")
+    same_platform = xla.get("platform") == auto.get("platform")
+    # a real A/B needs the two legs to have EXECUTED different fixed-point
+    # paths — off-TPU both resolve to the XLA scan ('xla' vs 'xla-fallback'
+    # labels, identical code) and a ~1.0 ratio would be noise, not a result
+    distinct_paths = auto.get("fp_path") == "pallas" and xla.get("fp_path") == "xla"
+    if vx and va and same_platform and distinct_paths:
+        rec["auto_over_xla"] = round(va / vx, 4)
+        rec["platform"] = xla["platform"]
+    else:
+        rec["auto_over_xla"] = None
+        rec["note"] = ("ratio withheld: " +
+                       ("platform mismatch or failed run" if not same_platform
+                        or not (vx and va)
+                        else "both legs executed the XLA scan (off-TPU or "
+                             "beyond the kernel's measured-win shapes)"))
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "w") as f:
+        json.dump(rec, f, indent=1)
+        f.write("\n")
+    print(json.dumps({k: rec.get(k) for k in
+                      ("auto_over_xla", "platform", "note")}))
+    print(f"wrote {OUT}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

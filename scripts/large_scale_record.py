@@ -1,0 +1,273 @@
+"""Beyond-paper-scale RECORD — commits `benchmarks/large_scale.json`.
+
+Round-3 verdict weakness #4: the sharded large-graph paths (`parallel.ring`
+ppermute min-plus APSP, `parallel.partition` halo-exchange fixed point and
+ChebNet — SURVEY.md §5.7's "ring attention equivalent") were bit-equality
+TESTED but had no committed record of doing useful work at scale.  This
+driver produces that record:
+
+* `mesh_*` legs — the sharded paths on an 8-virtual-device CPU mesh at
+  N=1024 / L=2048 / E=2048 (sizes the paper's workload never reaches),
+  timed against the single-device dense path on the SAME host, with
+  max|diff| reported.  One host executes all 8 virtual devices, so these
+  legs prove schedule + correctness at scale, not wall-clock speedup —
+  the JSON says so.
+* `chip_pipeline` leg — the full single-chip pipeline at N=1024 with the
+  blocked-FW Pallas APSP (`scripts/large_scale_demo.py --backward`), run
+  only when the TPU answers; otherwise recorded as pending with the
+  diagnostic.
+
+Every leg runs in a wall-clock-bounded subprocess (the tunneled chip can
+wedge, `utils.subproc`).  Reruns merge into the existing JSON, so the chip
+leg can be filled in when the hardware recovers.
+
+Usage: python scripts/large_scale_record.py [--skip-chip] [--devices 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "benchmarks", "large_scale.json")
+_CHILD_ENV = "_MHO_LARGESCALE_CHILD"
+_MESH_TIMEOUT_S = 900.0
+_CHIP_TIMEOUT_S = 420.0
+
+
+# --------------------------------------------------------------------------
+# child: the virtual-mesh legs (runs with JAX_PLATFORMS=cpu + forced devices)
+# --------------------------------------------------------------------------
+
+def _mesh_child(n_devices: int) -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax import shard_map
+
+    from multihop_offload_tpu.env.apsp import apsp_minplus
+    from multihop_offload_tpu.env.queueing import interference_fixed_point_raw
+    from multihop_offload_tpu.models import ChebNet
+    from multihop_offload_tpu.parallel.partition import (
+        sharded_interference_fixed_point,
+        sharded_spectral_forward,
+    )
+    from multihop_offload_tpu.parallel.ring import sharded_apsp
+
+    devices = jax.devices()[:n_devices]
+    mesh = Mesh(np.asarray(devices), ("graph",))
+    rng = np.random.default_rng(0)
+    legs = {}
+
+    def timeit(fn, *args, reps=3):
+        out = jax.block_until_ready(fn(*args))  # compile
+        t0 = time.time()
+        for _ in range(reps):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        return out, (time.time() - t0) / reps * 1e3  # ms
+
+    # --- ring APSP at N=1024 -------------------------------------------
+    n = 1024
+    w = rng.uniform(0.1, 5.0, (n, n)).astype(np.float32)
+    w = np.minimum(w, w.T)
+    mask = rng.uniform(size=(n, n)) < 0.01
+    mask = mask | mask.T
+    w = np.where(mask, w, np.inf).astype(np.float32)
+    np.fill_diagonal(w, 0.0)
+    w = jnp.asarray(w)
+
+    ring = jax.jit(
+        shard_map(
+            lambda x: sharded_apsp(x, "graph"), mesh=mesh,
+            in_specs=P(), out_specs=P(), check_vma=False,
+        )
+    )
+    dense = jax.jit(apsp_minplus)
+    out_ring, ms_ring = timeit(ring, w)
+    out_dense, ms_dense = timeit(dense, w)
+    finite = np.isfinite(np.asarray(out_dense))
+    diff = float(np.max(np.abs(
+        np.asarray(out_ring)[finite] - np.asarray(out_dense)[finite]
+    )))
+    legs["mesh_ring_apsp_n1024"] = {
+        "n": n, "devices": n_devices, "sharded_ms": round(ms_ring, 1),
+        "single_device_ms": round(ms_dense, 1), "max_abs_diff": diff,
+    }
+
+    # --- halo fixed point at L=2048 ------------------------------------
+    l = 2048
+    adj = (rng.uniform(size=(l, l)) < 0.005).astype(np.float32)
+    adj = np.triu(adj, 1)
+    adj = adj + adj.T
+    rates = rng.uniform(30, 70, l).astype(np.float32)
+    cf = adj.sum(0).astype(np.float32)
+    lam = rng.uniform(0, 50, l).astype(np.float32)
+    args_fp = tuple(jnp.asarray(x) for x in (adj, rates, cf, lam))
+
+    fp_sharded = jax.jit(
+        shard_map(
+            lambda a, r, c, m: lax.all_gather(
+                sharded_interference_fixed_point(a, r, c, m, "graph"),
+                "graph", axis=0, tiled=True,
+            ),
+            mesh=mesh,
+            in_specs=(P("graph"), P("graph"), P("graph"), P("graph")),
+            out_specs=P(), check_vma=False,
+        )
+    )
+    fp_dense = jax.jit(lambda a, r, c, m: interference_fixed_point_raw(a, r, c, m))
+    out_s, ms_s = timeit(fp_sharded, *args_fp)
+    out_d, ms_d = timeit(fp_dense, *args_fp)
+    legs["mesh_halo_fixed_point_l2048"] = {
+        "l": l, "devices": n_devices, "sharded_ms": round(ms_s, 2),
+        "single_device_ms": round(ms_d, 2),
+        "max_abs_diff": float(np.max(np.abs(np.asarray(out_s) - np.asarray(out_d)))),
+    }
+
+    # --- halo ChebNet forward at E=2048, K=3 ---------------------------
+    e = 2048
+    model = ChebNet(k=3)
+    sup = (rng.uniform(size=(e, e)) < 0.005).astype(np.float32)
+    sup = ((sup + sup.T) / 2).astype(np.float32)
+    feats = rng.uniform(size=(e, 4)).astype(np.float32)
+    sup, feats = jnp.asarray(sup), jnp.asarray(feats)
+    variables = model.init(jax.random.PRNGKey(0), feats, sup)
+
+    cheb_sharded = jax.jit(
+        shard_map(
+            lambda f, s: sharded_spectral_forward(model, variables, f, s, "graph"),
+            mesh=mesh, in_specs=(P(), P()), out_specs=P(), check_vma=False,
+        )
+    )
+    cheb_dense = jax.jit(lambda f, s: model.apply(variables, f, s))
+    out_s, ms_s = timeit(cheb_sharded, feats, sup)
+    out_d, ms_d = timeit(cheb_dense, feats, sup)
+    legs["mesh_halo_chebnet_e2048"] = {
+        "e": e, "cheb_k": 3, "devices": n_devices,
+        "sharded_ms": round(ms_s, 2), "single_device_ms": round(ms_d, 2),
+        "max_abs_diff": float(np.max(np.abs(np.asarray(out_s) - np.asarray(out_d)))),
+    }
+
+    print(json.dumps(legs))
+
+
+# --------------------------------------------------------------------------
+# parent: orchestrate bounded children, merge the record
+# --------------------------------------------------------------------------
+
+def _last_json_line(text: str):
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{") and line.endswith("}"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--skip-chip", action="store_true",
+                    help="skip the TPU pipeline leg (e.g. chip wedged)")
+    ap.add_argument("--leg", default=None, help=argparse.SUPPRESS)
+    args = ap.parse_args()
+
+    if os.environ.get(_CHILD_ENV):
+        _mesh_child(args.devices)
+        return 0
+
+    from multihop_offload_tpu.utils.subproc import run_bounded_child
+
+    record = {}
+    if os.path.isfile(OUT):
+        with open(OUT) as f:
+            record = json.load(f)
+    record.setdefault(
+        "description",
+        "Beyond-paper-scale record: sharded large-graph paths on an "
+        "8-virtual-device CPU mesh (schedule + correctness at scale; one "
+        "host runs all devices, so sharded_ms vs single_device_ms is NOT a "
+        "speedup claim) and the full N=1024 pipeline on the real chip.",
+    )
+    legs = record.setdefault("legs", {})
+
+    # --- virtual-mesh legs ---------------------------------------------
+    here = os.path.abspath(__file__)
+    res = run_bounded_child(
+        [sys.executable, here, "--devices", str(args.devices)],
+        timeout_s=_MESH_TIMEOUT_S,
+        extra_env={
+            _CHILD_ENV: "1",
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "")
+                          + f" --xla_force_host_platform_device_count={args.devices}"),
+        },
+        cwd=REPO,
+    )
+    mesh_legs = _last_json_line(res.stdout) if res.ok else None
+    if mesh_legs:
+        legs.update(mesh_legs)
+        print(f"mesh legs ok: {sorted(mesh_legs)}")
+    else:
+        tail = (res.stderr or res.stdout).strip().splitlines()[-5:]
+        print("mesh legs FAILED: " + " | ".join(tail), file=sys.stderr)
+
+    # --- chip pipeline leg ---------------------------------------------
+    if not args.skip_chip:
+        demo = os.path.join(REPO, "scripts", "large_scale_demo.py")
+        res = run_bounded_child(
+            [sys.executable, demo, "--n", "1024", "--apsp", "auto",
+             "--steps", "3", "--backward"],
+            timeout_s=_CHIP_TIMEOUT_S, cwd=REPO,
+        )
+        chip = _last_json_line(res.stdout) if res.ok else None
+        # "ran" != "ran on the chip": a clean CPU fallback exits 0 with
+        # apsp='xla-fallback'; only a Pallas path proves TPU execution
+        on_chip = chip is not None and chip.get("apsp") in (
+            "blocked-fw", "squaring"
+        )
+        if on_chip:
+            chip["captured_unix"] = int(time.time())
+            legs["chip_pipeline_n1024"] = chip
+            print(f"chip leg ok: apsp={chip.get('apsp')} "
+                  f"step_s={chip.get('step_s')}")
+        else:
+            if chip is not None:
+                why = f"ran but not on the chip (apsp={chip.get('apsp')!r})"
+            else:
+                tail = (res.stderr or res.stdout).strip().splitlines()[-4:]
+                why = (("timeout" if res.timed_out else f"rc={res.returncode}")
+                       + ": " + " | ".join(tail))
+            # never annotate a previously SUCCESSFUL record with 'pending'
+            prior = legs.get("chip_pipeline_n1024", {})
+            if "step_s" in prior:
+                print(f"chip leg failed ({why}); keeping the prior successful "
+                      "record untouched", file=sys.stderr)
+            else:
+                legs["chip_pipeline_n1024"] = {"pending": why}
+                print("chip leg pending: " + why, file=sys.stderr)
+
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "w") as f:
+        json.dump(record, f, indent=1)
+        f.write("\n")
+    print(f"wrote {OUT}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -381,3 +381,38 @@ def test_forward_backward_invariant_to_fp_impl():
     for gx, gp in zip(flat_x, flat_p):
         np.testing.assert_allclose(np.asarray(gx), np.asarray(gp),
                                    rtol=1e-7, atol=1e-10)
+
+
+def test_fixed_point_pallas_under_vmap():
+    """The bench/driver A/B vmaps forward_backward over episodes with
+    `fp_fn` bound — i.e. jax.vmap over the custom_vjp-wrapped pallas_call.
+    Exercise exactly that composition (values + grads) in interpret mode."""
+    import jax
+
+    from multihop_offload_tpu.ops import fixed_point_pallas
+
+    rng = np.random.default_rng(5)
+    l, b = 32, 4
+    adj = (rng.random((b, l, l)) < 0.2).astype(np.float32)
+    for i in range(b):
+        adj[i] = np.maximum(adj[i], adj[i].T)
+        np.fill_diagonal(adj[i], 0.0)
+    rates = rng.uniform(30, 70, (b, l)).astype(np.float32)
+    cf = adj.sum(-1).astype(np.float32)
+    lam = rng.uniform(0, 5, (b, l)).astype(np.float32)
+
+    one = lambda a, r, c, m: fixed_point_pallas(a, r, c, m, 10, True)
+    got = jax.vmap(one)(*map(jnp.asarray, (adj, rates, cf, lam)))
+    want = _fp_xla(adj, rates, cf, lam)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
+
+    def loss(ms):
+        return jnp.sum(jax.vmap(one)(jnp.asarray(adj), jnp.asarray(rates),
+                                     jnp.asarray(cf), ms) ** 2)
+
+    g = jax.grad(loss)(jnp.asarray(lam))
+    g_ref = jax.grad(
+        lambda ms: jnp.sum(_fp_xla(adj, rates, cf, ms) ** 2)
+    )(jnp.asarray(lam))
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                               rtol=1e-5, atol=1e-8)
