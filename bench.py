@@ -307,14 +307,11 @@ def _run_child(extra_env: dict, timeout_s: float):
     if not res.ok:
         tail = (res.stderr or res.stdout).strip().splitlines()[-6:]
         return False, None, f"rc={res.returncode}: " + " | ".join(tail)
-    for line in reversed(res.stdout.strip().splitlines()):
-        line = line.strip()
-        if line.startswith("{") and line.endswith("}"):
-            try:
-                json.loads(line)
-            except json.JSONDecodeError:
-                continue
-            return True, line, None
+    from multihop_offload_tpu.utils.subproc import last_json_line
+
+    rec = last_json_line(res.stdout)
+    if rec is not None:
+        return True, json.dumps(rec), None
     return False, None, "child produced no JSON line"
 
 

@@ -27,16 +27,45 @@ def _minplus_square(d: jnp.ndarray) -> jnp.ndarray:
     return jnp.minimum(d, jnp.min(d[:, :, None] + d[None, :, :], axis=1))
 
 
-def apsp_minplus(weights: jnp.ndarray, num_iters: int | None = None) -> jnp.ndarray:
+def apsp_minplus(
+    weights: jnp.ndarray,
+    num_iters: int | None = None,
+    early_stop: bool = True,
+) -> jnp.ndarray:
     """Shortest-path distance matrix from a one-hop weight matrix.
 
     `weights`: (N, N), w[u,v] = edge weight (inf where no edge), any diagonal
     (it is forced to 0).  Returns distances with zero diagonal.
+
+    `early_stop` (default): run the squarings in a `lax.while_loop` that
+    exits once a squaring leaves the matrix unchanged.  Min-plus squaring is
+    idempotent at the fixed point, so the result is IDENTICAL to the full
+    ceil(log2(N-1)) schedule; convergence arrives after
+    ceil(log2(longest-shortest-path-edge-count)) squarings, which on the
+    small-diameter workload graphs is 3-4 of the worst-case 7 — and the
+    APSP term dominates the step (benchmarks/profile_r04.md), so the saved
+    O(N^3) passes are the single biggest step-time lever.  Under `vmap` the
+    loop runs until every lane converges (still <= the static schedule).
+    The decision paths consume APSP on stopped values only, so the
+    (non-reverse-differentiable) while_loop changes no gradient path.
     """
     n = weights.shape[-1]
     d = jnp.where(jnp.eye(n, dtype=bool), jnp.zeros_like(weights), weights)
     iters = num_iters if num_iters is not None else max(1, math.ceil(math.log2(max(n - 1, 2))))
-    return lax.fori_loop(0, iters, lambda _, x: _minplus_square(x), d)
+    if not early_stop:
+        return lax.fori_loop(0, iters, lambda _, x: _minplus_square(x), d)
+
+    def cond(state):
+        i, _, done = state
+        return jnp.logical_and(i < iters, jnp.logical_not(done))
+
+    def body(state):
+        i, cur, _ = state
+        nxt = _minplus_square(cur)
+        return i + 1, nxt, jnp.all(nxt == cur)
+
+    _, d, _ = lax.while_loop(cond, body, (jnp.int32(0), d, jnp.bool_(False)))
+    return d
 
 
 def hop_matrix(adj: jnp.ndarray) -> jnp.ndarray:

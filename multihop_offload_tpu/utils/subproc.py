@@ -72,3 +72,20 @@ def run_bounded_child(
 def python_child_argv(code: str) -> list[str]:
     """argv for running a snippet under the current interpreter."""
     return [sys.executable, "-c", code]
+
+
+def last_json_line(stdout: str):
+    """The child-JSON-over-stdout protocol's parser: the LAST line of
+    `stdout` that parses as a JSON object, or None.  One definition shared
+    by every bounded-child caller (bench.py, scripts/fp_ab.py,
+    scripts/large_scale_record.py) so the protocol can't drift per copy."""
+    import json
+
+    for line in reversed(stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{") and line.endswith("}"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None

@@ -22,18 +22,17 @@ OUT = os.path.join(REPO, "benchmarks", "fp_ab.json")
 
 
 def run_bench(fp_impl: str):
+    sys.path.insert(0, REPO)
+    from multihop_offload_tpu.utils.subproc import last_json_line
+
     env = dict(os.environ, BENCH_FP_IMPL=fp_impl)
     res = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],
         env=env, capture_output=True, text=True, cwd=REPO,
     )
-    for line in reversed(res.stdout.strip().splitlines()):
-        line = line.strip()
-        if line.startswith("{") and line.endswith("}"):
-            try:
-                return json.loads(line)
-            except json.JSONDecodeError:
-                continue
+    rec = last_json_line(res.stdout)
+    if rec is not None:
+        return rec
     return {"error": f"rc={res.returncode}: "
             + " | ".join((res.stderr or res.stdout).strip().splitlines()[-3:])}
 

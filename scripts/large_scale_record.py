@@ -97,13 +97,18 @@ def _mesh_child(n_devices: int) -> None:
     dense = jax.jit(apsp_minplus)
     out_ring, ms_ring = timeit(ring, w)
     out_dense, ms_dense = timeit(dense, w)
-    finite = np.isfinite(np.asarray(out_dense))
-    diff = float(np.max(np.abs(
-        np.asarray(out_ring)[finite] - np.asarray(out_dense)[finite]
-    )))
+    ring_np, dense_np = np.asarray(out_ring), np.asarray(out_dense)
+    # the inf masks must MATCH (a fabricated finite distance where dense
+    # says unreachable is a real bug, not a skippable entry), then finite
+    # entries compare exactly
+    inf_match = bool((np.isinf(ring_np) == np.isinf(dense_np)).all())
+    finite = np.isfinite(dense_np)
+    diff = float(np.max(np.abs(ring_np[finite] - dense_np[finite]))) \
+        if inf_match else float("inf")
     legs["mesh_ring_apsp_n1024"] = {
         "n": n, "devices": n_devices, "sharded_ms": round(ms_ring, 1),
         "single_device_ms": round(ms_dense, 1), "max_abs_diff": diff,
+        "inf_masks_match": inf_match,
     }
 
     # --- halo fixed point at L=2048 ------------------------------------
@@ -167,15 +172,7 @@ def _mesh_child(n_devices: int) -> None:
 # parent: orchestrate bounded children, merge the record
 # --------------------------------------------------------------------------
 
-def _last_json_line(text: str):
-    for line in reversed(text.strip().splitlines()):
-        line = line.strip()
-        if line.startswith("{") and line.endswith("}"):
-            try:
-                return json.loads(line)
-            except json.JSONDecodeError:
-                continue
-    return None
+from multihop_offload_tpu.utils.subproc import last_json_line as _last_json_line  # noqa: E402
 
 
 def main() -> int:

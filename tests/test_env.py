@@ -287,3 +287,32 @@ def test_local_greedy_mwis_respects_mask():
                                    jnp.asarray(mask))
     assert set(np.flatnonzero(np.asarray(got))) == {1, 2}
     assert float(total) == 12.0
+
+
+def test_apsp_early_stop_equals_static_schedule(rng):
+    """The while_loop early exit must be value-identical to the full
+    ceil(log2(N-1)) schedule (min-plus squaring is idempotent at the fixed
+    point), including +inf disconnected entries, scalar and vmapped."""
+    import functools
+
+    import jax
+
+    n, b = 48, 6
+    w = rng.uniform(0.1, 5.0, (b, n, n)).astype(np.float32)
+    w = np.minimum(w, w.transpose(0, 2, 1))
+    mask = rng.uniform(size=(b, n, n)) < 0.06
+    mask = mask | mask.transpose(0, 2, 1)
+    w = np.where(mask, w, np.inf).astype(np.float32)
+    wj = jnp.asarray(w)
+
+    static = jax.jit(jax.vmap(functools.partial(apsp_minplus, early_stop=False)))
+    early = jax.jit(jax.vmap(apsp_minplus))
+    a, c = np.asarray(early(wj)), np.asarray(static(wj))
+    assert (np.isinf(a) == np.isinf(c)).all()
+    fin = np.isfinite(c)
+    np.testing.assert_array_equal(a[fin], c[fin])
+    # scalar path too
+    np.testing.assert_array_equal(
+        np.asarray(apsp_minplus(wj[0])), np.asarray(
+            apsp_minplus(wj[0], early_stop=False))
+    )
