@@ -176,6 +176,15 @@ def measure():
     fp_impl = os.environ.get("BENCH_FP_IMPL", "auto")
     apsp_fn, apsp_path = resolve_apsp(apsp_impl, pad.n)
     fp_fn, fp_path = resolve_fixed_point(fp_impl, pad.l)
+    # BENCH_APSP_EARLY=0 pins the static squaring schedule — the bisect
+    # switch for the early-stop while_loop when comparing BENCH rounds
+    if os.environ.get("BENCH_APSP_EARLY", "1") == "0" and apsp_fn is None:
+        import functools as _ft
+
+        from multihop_offload_tpu.env.apsp import apsp_minplus as _apsp
+
+        apsp_fn = _ft.partial(_apsp, early_stop=False)
+        apsp_path = "xla-static"
 
     @jax.jit
     def step(variables, insts, jobs, keys):

@@ -288,3 +288,25 @@ def test_best_checkpoint_tracking(tmp_path, tiny_dataset, monkeypatch):
     ev = Evaluator(Config(**{**cfg.__dict__}))
     step_best = ev.try_restore(which="best")
     assert step_best == rec["step"]
+
+
+def test_csv_flusher_append_equals_rewrite(tmp_path):
+    """Append-mode flushing must produce byte-identical files to the full
+    per-flush rewrite it replaced (reference per-file flush semantics)."""
+    import pandas as pd
+
+    from multihop_offload_tpu.train.driver import _CsvFlusher
+
+    cols = ["a", "b", "c"]
+    rows = []
+    p_new = str(tmp_path / "append.csv")
+    p_old = str(tmp_path / "rewrite.csv")
+    fl = _CsvFlusher(p_new, cols)
+    rng = np.random.default_rng(0)
+    for step in range(7):
+        for _ in range(int(rng.integers(0, 4))):
+            rows.append({"a": float(rng.normal()), "b": int(rng.integers(100)),
+                         "c": f"s{rng.integers(10)}"})
+        fl.flush(rows)
+        pd.DataFrame(rows, columns=cols).to_csv(p_old, index=False)
+    assert open(p_new, "rb").read() == open(p_old, "rb").read()
