@@ -84,6 +84,13 @@ class Config:
     compat_diagonal_bug: bool = False  # reproduce the reference's cycled
     #                                decision-path diagonal (A/B validation;
     #                                see agent.actor.compat_cycled_diagonal)
+    prefetch: bool = True          # one-deep host/device pipeline in the
+    #                                sequential Trainer/Evaluator loops:
+    #                                build file fid+1 host-side while the
+    #                                device runs fid.  Holds TWO files'
+    #                                instance/jobset buffers on device during
+    #                                the overlap window — disable on
+    #                                HBM-tight runs.
     file_batch: int = 1            # files evaluated per device program in
     #                                the Evaluator (vmap over stacked files;
     #                                multiplies with the data-mesh width)
