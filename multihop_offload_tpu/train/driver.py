@@ -368,6 +368,50 @@ class _Harness:
         return step
 
 
+class _Prefetcher:
+    """One-deep host/device pipeline over a work list — the ONE
+    implementation of the prefetch scaffold shared by the Trainer loop and
+    both Evaluator loops.
+
+    Protocol per iteration: `current()` yields the prepared item (building
+    on demand when disabled); after dispatching the device program, call
+    `prefetch_next()` to build the NEXT item while the device runs — it
+    returns the build's wall seconds (0.0 when nothing was built) for the
+    runtime-net-of-overlap accounting; after the iteration's rows are
+    flushed, `raise_deferred()` re-raises any prefetch failure — deferring
+    it past the flush preserves the crash-safe "every completed item is in
+    the CSV" property.
+    """
+
+    def __init__(self, items, build, enabled: bool):
+        self.items, self.build, self.enabled = list(items), build, enabled
+        self.idx = 0
+        self.err = None
+        self._prepared = (
+            build(self.items[0])[0] if enabled and self.items else None
+        )
+
+    def current(self):
+        if not self.enabled:
+            return self.build(self.items[self.idx])[0]
+        return self._prepared
+
+    def prefetch_next(self) -> float:
+        self.idx += 1
+        if not self.enabled or self.idx >= len(self.items):
+            return 0.0
+        try:
+            self._prepared, secs = self.build(self.items[self.idx])
+            return secs
+        except Exception as e:  # deferred: the caller flushes first
+            self.err = e
+            return 0.0
+
+    def raise_deferred(self) -> None:
+        if self.err is not None:
+            raise self.err
+
+
 class _CsvFlusher:
     """Reference-parity per-file CSV flushing without the O(n^2) rewrite.
 
@@ -527,14 +571,9 @@ class Trainer(_Harness):
             # file's train + eval programs (the epoch boundary stays
             # synchronous — next epoch's permutation must draw AFTER this
             # epoch's builds)
-            prepared = (
-                _build_file(order[0])[0] if cfg.prefetch and len(order)
-                else None
-            )
-            for oidx, fid in enumerate(order):
-                if not cfg.prefetch:
-                    prepared = _build_file(fid)[0]
-                rec, inst, jobsets, counts = prepared
+            pf = _Prefetcher(order, _build_file, cfg.prefetch)
+            for fid in order:
+                rec, inst, jobsets, counts = pf.current()
                 t0 = time.time()
                 if self.n_dp > 1:
                     # pad the episode batch to a device-divisible width; the
@@ -565,12 +604,7 @@ class Trainer(_Harness):
                         self.variables, inst, jobsets,
                         self.next_keys(cfg.num_instances)
                     )
-                next_err, next_build_s = None, 0.0
-                if cfg.prefetch and oidx + 1 < len(order):
-                    try:
-                        prepared, next_build_s = _build_file(order[oidx + 1])
-                    except Exception as e:  # defer: flush fid's rows first
-                        next_err = e
+                next_build_s = pf.prefetch_next()
                 jax.block_until_ready(gnn_test)
                 # runtime approximates METHOD compute only, net of the
                 # overlapped successor build — the reference's timer likewise
@@ -624,8 +658,7 @@ class Trainer(_Harness):
                     losses = []
                 gidx += 1
                 train_csv.flush(rows)
-                if next_err is not None:
-                    raise next_err
+                pf.raise_deferred()
         tb.flush()
         return csv_path
 
@@ -641,6 +674,23 @@ class Evaluator(_Harness):
         rates and jobsets are identical no matter how files are ordered or
         sharded over devices (the file-DP path visits bucket-by-bucket)."""
         return np.random.default_rng((self.cfg.seed, fid))
+
+    def _build_file(self, fid: int):
+        """Host-side per-file prep — the ONE definition of the workload
+        draw for file `fid`, shared by the sequential and file-DP eval
+        paths so `file_batch>1` and `==1` realize identical workloads for
+        the same seed.  Returns ((rec, inst, jobsets, counts), seconds)."""
+        cfg = self.cfg
+        t0 = time.time()
+        rec = self.data.records[fid]
+        frng = self._file_rng(fid)
+        inst = self.data.instance(fid, frng)
+        jobsets, counts = sample_jobsets(
+            rec, self.data.pad_of(fid), cfg.num_instances, frng,
+            cfg.arrival_scale, ul=cfg.ul_data, dl=cfg.dl_data,
+            dtype=cfg.jnp_dtype,
+        )
+        return (rec, inst, jobsets, counts), time.time() - t0
 
     def run(self, files_limit: Optional[int] = None, out_dir: Optional[str] = None,
             verbose: bool = True):
@@ -666,51 +716,24 @@ class Evaluator(_Harness):
         else:
             eval_csv = _CsvFlusher(csv_path, TEST_COLUMNS, enabled=self.is_host0)
             rows = []
-
-            def build(fid):
-                """Host-side file preparation (mat-derived instance, padded
-                jobsets) — everything upstream of the device call.  Returns
-                the prepared tuple plus its own wall time, so the pipeline
-                can attribute build cost to the file it belongs to."""
-                t0 = time.time()
-                rec = self.data.records[fid]
-                frng = self._file_rng(fid)
-                inst = self.data.instance(fid, frng)
-                jobsets, counts = sample_jobsets(
-                    rec, self.data.pad_of(fid), cfg.num_instances, frng,
-                    cfg.arrival_scale, ul=cfg.ul_data, dl=cfg.dl_data,
-                    dtype=cfg.jnp_dtype,
-                )
-                return (rec, inst, jobsets, counts), time.time() - t0
-
-            # one-file host/device pipeline (cfg.prefetch): jax dispatch is
-            # async, so the NEXT file's host build runs while the device
-            # computes the current one.  The per-file RNG (`_file_rng`) keys
-            # workloads by fid alone, so prefetch order cannot change any
-            # realized workload.  `runtime` approximates METHOD compute
-            # only, net of the overlapped successor build — the reference's
-            # timer likewise excludes file prep (`AdHoc_test.py:126`); the
-            # subtraction is exact when host and device serialize
-            # (single-core CPU) and underestimates when a true-overlap
-            # build outlasts the device step.  A failure while prefetching
-            # fid+1 is DEFERRED until file fid's rows are computed and
-            # flushed, preserving the old loop's crash-safe "every
-            # completed file is in the CSV" property.
-            prepared = build(0)[0] if cfg.prefetch and n_files else None
+            # one-file host/device pipeline (`_Prefetcher`, cfg.prefetch):
+            # jax dispatch is async, so the NEXT file's host build runs
+            # while the device computes the current one.  The per-file RNG
+            # (`_file_rng`) keys workloads by fid alone, so prefetch order
+            # cannot change any realized workload.  `runtime` approximates
+            # METHOD compute only, net of the overlapped successor build —
+            # the reference's timer likewise excludes file prep
+            # (`AdHoc_test.py:126`); the subtraction is exact when host and
+            # device serialize (single-core CPU) and underestimates when a
+            # true-overlap build outlasts the device step.
+            pf = _Prefetcher(range(n_files), self._build_file, cfg.prefetch)
             for fid in range(n_files):
-                if not cfg.prefetch:
-                    prepared = build(fid)[0]
-                rec, inst, jobsets, counts = prepared
+                rec, inst, jobsets, counts = pf.current()
                 t0 = time.time()
                 bl, loc, gnn = self._eval_methods(
                     self.variables, inst, jobsets, self.next_keys(cfg.num_instances)
                 )
-                next_err, next_build_s = None, 0.0
-                if cfg.prefetch and fid + 1 < n_files:
-                    try:
-                        prepared, next_build_s = build(fid + 1)
-                    except Exception as e:  # defer: flush fid's rows first
-                        next_err = e
+                next_build_s = pf.prefetch_next()
                 jax.block_until_ready(gnn)
                 wall = time.time() - t0
                 runtime = max(wall - next_build_s, 0.0) / (3 * cfg.num_instances)
@@ -724,8 +747,7 @@ class Evaluator(_Harness):
                     print(f"[{fid + 1}/{n_files}] {rec.filename} "
                           f"({wall:.3f}s for {3 * cfg.num_instances} evals)")
                 eval_csv.flush(rows)
-                if next_err is not None:
-                    raise next_err
+                pf.raise_deferred()
         return csv_path
 
     def _run_files_dp(self, n_files: int, verbose: bool, flush):
@@ -742,55 +764,69 @@ class Evaluator(_Harness):
         by_bucket = {}
         for fid in range(n_files):
             by_bucket.setdefault(self.data.bucket_of[fid], []).append(fid)
+        # the full chunk schedule up front (bucket-ordered), so the
+        # chunk-level host/device pipeline below can prefetch across bucket
+        # boundaries; per-file RNG is keyed by fid so build order is free
+        chunks = [
+            (bucket, fids[c0: c0 + self.eval_chunk])
+            for bucket, fids in sorted(by_bucket.items())
+            for c0 in range(0, len(fids), self.eval_chunk)
+        ]
+
+        def build_chunk(bucket_chunk):
+            """Host build of one chunk's stacked instances/jobsets — each
+            file through the SHARED `_build_file` (one workload-draw
+            definition across eval paths)."""
+            _, chunk = bucket_chunk
+            t0 = time.time()
+            insts, jsets, cnts = [], [], []
+            for fid in chunk:
+                (_, inst, js, counts), _ = self._build_file(fid)
+                insts.append(inst)
+                jsets.append(js)
+                cnts.append(counts)
+            for _ in range(self.eval_chunk - len(chunk)):  # pad: no RNG draws
+                insts.append(insts[-1])
+                jsets.append(jsets[-1])
+            return (stack_instances(insts), stack_instances(jsets), jsets,
+                    cnts), time.time() - t0
+
         rows_by_fid = {}
         done = 0
-        for bucket, fids in sorted(by_bucket.items()):
-            for c0 in range(0, len(fids), self.eval_chunk):
-                chunk = fids[c0: c0 + self.eval_chunk]
-                real = len(chunk)
-                insts, jsets, cnts = [], [], []
-                for fid in chunk:
-                    rec = self.data.records[fid]
-                    frng = self._file_rng(fid)
-                    insts.append(self.data.instance(fid, frng))
-                    js, counts = sample_jobsets(
-                        rec, self.data.pad_of(fid), cfg.num_instances, frng,
-                        cfg.arrival_scale, ul=cfg.ul_data, dl=cfg.dl_data,
-                        dtype=cfg.jnp_dtype,
-                    )
-                    jsets.append(js)
-                    cnts.append(counts)
-                for _ in range(self.eval_chunk - real):  # pad: no RNG draws
-                    insts.append(insts[-1])
-                    jsets.append(jsets[-1])
-                binst = stack_instances(insts)
-                bjobs = stack_instances(jsets)
-                keys = self.next_keys(
-                    self.eval_chunk * cfg.num_instances
-                ).reshape(self.eval_chunk, cfg.num_instances, -1)
-                t0 = time.time()
-                bl, loc, gnn = self._eval_files_dp(
-                    self.variables, binst, bjobs, keys
+        pf = _Prefetcher(chunks, build_chunk, cfg.prefetch)
+        for bucket, chunk in chunks:
+            binst, bjobs, jsets, cnts = pf.current()
+            real = len(chunk)
+            keys = self.next_keys(
+                self.eval_chunk * cfg.num_instances
+            ).reshape(self.eval_chunk, cfg.num_instances, -1)
+            t0 = time.time()
+            bl, loc, gnn = self._eval_files_dp(
+                self.variables, binst, bjobs, keys
+            )
+            next_build_s = pf.prefetch_next()
+            jax.block_until_ready(gnn)
+            wall = time.time() - t0
+            # normalize by the full chunk width: pad slots run in parallel,
+            # so per-eval cost is t/(3*I*eval_chunk); method compute only,
+            # net of the overlapped successor build (see the sequential loop)
+            runtime = max(wall - next_build_s, 0.0) / (
+                3 * cfg.num_instances * self.eval_chunk
+            )
+            for d in range(real):
+                fid = chunk[d]
+                metrics = _method_metrics(
+                    {"baseline": bl[d], "local": loc[d], "GNN": gnn[d]},
+                    bl[d], jsets[d].mask, float(cfg.T),
                 )
-                jax.block_until_ready(gnn)
-                # normalize by the full chunk width: pad slots run in
-                # parallel, so per-eval cost is t/(3*I*eval_chunk) per chunk
-                runtime = (time.time() - t0) / (
-                    3 * cfg.num_instances * self.eval_chunk
+                rows_by_fid[fid] = _rows(
+                    self.data.records[fid], cnts[d], metrics, runtime, fid,
+                    algo_col="Algo", fid_col=False,
                 )
-                for d in range(real):
-                    fid = chunk[d]
-                    metrics = _method_metrics(
-                        {"baseline": bl[d], "local": loc[d], "GNN": gnn[d]},
-                        bl[d], jsets[d].mask, float(cfg.T),
-                    )
-                    rows_by_fid[fid] = _rows(
-                        self.data.records[fid], cnts[d], metrics, runtime, fid,
-                        algo_col="Algo", fid_col=False,
-                    )
-                done += real
-                if verbose:
-                    print(f"[{done}/{n_files}] bucket {bucket} chunk of {real} "
-                          f"({(time.time() - t0):.3f}s, chunk {self.eval_chunk} "
-                          f"on {self.n_dp} devices)")
-                flush([r for f in sorted(rows_by_fid) for r in rows_by_fid[f]])
+            done += real
+            if verbose:
+                print(f"[{done}/{n_files}] bucket {bucket} chunk of {real} "
+                      f"({wall:.3f}s, chunk {self.eval_chunk} "
+                      f"on {self.n_dp} devices)")
+            flush([r for f in sorted(rows_by_fid) for r in rows_by_fid[f]])
+            pf.raise_deferred()
