@@ -1,6 +1,12 @@
 """Figure regeneration CLI — the analysis-notebook equivalent.
 
     python -m multihop_offload_tpu.cli.plot out/Adhoc_test_data_*.csv --out fig/
+    python -m multihop_offload_tpu.cli.plot --route-demo data/case.mat --out fig/
+
+The route demo is the `plot_routes` smoke path (`offloading_v3.py:552-586`):
+one baseline-policy episode on a single case, per-link realized delay sums as
+edge widths, per-node compute sums as node sizes, spring-layout positions
+resolved (and cached) via `utils.visualization.layout_positions`.
 """
 
 from __future__ import annotations
@@ -9,19 +15,77 @@ import argparse
 import glob
 import os
 
-from multihop_offload_tpu.train.analysis import (
-    overall_table,
-    plot_test_figures,
-    plot_training_monitor,
-)
+
+def route_demo(case_path: str, out_dir: str, pos_cache: str | None = None) -> str:
+    import jax
+    import numpy as np
+
+    from multihop_offload_tpu.env.policies import baseline_policy
+    from multihop_offload_tpu.env.routing import link_incidence
+    from multihop_offload_tpu.graphs.instance import (
+        PadSpec, build_instance, build_jobset,
+    )
+    from multihop_offload_tpu.graphs.matio import load_case_mat
+    from multihop_offload_tpu.graphs.topology import sample_link_rates
+    from multihop_offload_tpu.utils.visualization import (
+        layout_positions, plot_routes,
+    )
+
+    rec = load_case_mat(case_path)
+    rng = np.random.default_rng(0)
+    rates = sample_link_rates(rec.topo, rec.link_rates, rng=rng)
+    pad = PadSpec.for_cases([rec.sizes], round_to=8)
+    inst = build_instance(rec.topo, rec.roles, rec.proc_bws, rates, 1000.0, pad)
+    mobile = rec.mobile_nodes
+    jobs = build_jobset(
+        mobile, 0.15 * rng.uniform(0.1, 0.5, mobile.size), pad_jobs=pad.j,
+    )
+    out = baseline_policy(inst, jobs, jax.random.PRNGKey(0))
+
+    n, l = rec.topo.n, rec.topo.num_links
+    uses = np.asarray(link_incidence(out.routes, inst.num_pad_links)).sum(1)[:l]
+    mu = np.asarray(out.delays.link_mu)[:l]
+    link_sums = uses / np.maximum(mu, 1e-9)
+    node_sums = np.zeros(n)
+    np.add.at(
+        node_sums,
+        np.asarray(out.decision.dst)[np.asarray(jobs.mask)],
+        np.asarray(out.delays.job_server)[np.asarray(jobs.mask)],
+    )
+    case = os.path.splitext(os.path.basename(case_path))[0]
+    pos = layout_positions(rec.topo, case_name=case, cache_dir=pos_cache)
+    return plot_routes(
+        rec.topo, pos, np.flatnonzero(rec.roles == 1),
+        mobile, link_sums, node_sums,
+        os.path.join(out_dir, f"routes_{case}.png"),
+    )
 
 
 def main(argv=None):
+    from multihop_offload_tpu.utils.platform import apply_platform_env
+
+    apply_platform_env()
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("csvs", nargs="+", help="result CSVs (test or training)")
+    p.add_argument("csvs", nargs="*", help="result CSVs (test or training)")
     p.add_argument("--out", default="fig", type=str)
+    p.add_argument("--route-demo", default=None, metavar="CASE_MAT",
+                   help="render a one-episode route figure for a .mat case")
+    p.add_argument("--pos-cache", default=None, metavar="DIR",
+                   help="position cache dir (reference ../pos/ equivalent)")
     args = p.parse_args(argv)
+    if not args.csvs and not args.route_demo:
+        p.error("provide result CSVs and/or --route-demo CASE_MAT")
+    if args.route_demo:
+        print("wrote", route_demo(args.route_demo, args.out, args.pos_cache))
+        if not args.csvs:
+            return
     import pandas as pd
+
+    from multihop_offload_tpu.train.analysis import (
+        overall_table,
+        plot_test_figures,
+        plot_training_monitor,
+    )
 
     for pattern in args.csvs:
         for path in sorted(glob.glob(pattern)):

@@ -18,9 +18,50 @@ import numpy as np
 from multihop_offload_tpu.graphs.topology import Topology
 
 
+def layout_positions(
+    topo: Topology,
+    pos=None,
+    case_name: Optional[str] = None,
+    cache_dir: Optional[str] = None,
+    seed: int = 0,
+) -> np.ndarray:
+    """Resolve node positions for drawing, mirroring the reference's
+    `node_positions` (`offloading_v3.py:152-165`): an explicit (N, 2) array is
+    used as-is; `pos='new'` forces a fresh spring layout; `pos=None` computes
+    a spring layout, read/written through an on-disk cache when `cache_dir`
+    and `case_name` are given (the reference pickles into `../pos/`; we store
+    a plain ``graph_c_pos_<case>.npy`` — same role, no pickle).
+
+    This is the out-of-the-box layout path for geometry-free families
+    (BA/ER/WS), whose `.mat` records carry no coordinates.
+    """
+    if isinstance(pos, np.ndarray):
+        return np.asarray(pos, dtype=np.float64)
+    if pos is not None and pos != "new":
+        raise ValueError("pos must be None, 'new', or an (N, 2) array")
+
+    cache_file = None
+    if pos is None and cache_dir is not None and case_name:
+        cache_file = os.path.join(cache_dir, f"graph_c_pos_{case_name}.npy")
+        if os.path.isfile(cache_file):
+            cached = np.load(cache_file)
+            if cached.shape == (topo.n, 2):
+                return cached
+
+    import networkx as nx
+
+    g = nx.from_numpy_array(topo.adj)
+    layout = nx.spring_layout(g, seed=seed)
+    out = np.asarray([layout[i] for i in range(topo.n)], dtype=np.float64)
+    if cache_file is not None:
+        os.makedirs(cache_dir, exist_ok=True)
+        np.save(cache_file, out)
+    return out
+
+
 def draw_network(
     topo: Topology,
-    pos: np.ndarray,
+    pos: Optional[np.ndarray],
     src_nodes: Sequence[int],
     dst_nodes: Sequence[int],
     edge_weights: Optional[np.ndarray] = None,
@@ -31,6 +72,8 @@ def draw_network(
     import matplotlib.pyplot as plt
     import networkx as nx
 
+    if pos is None:
+        pos = layout_positions(topo)
     g = nx.from_numpy_array(topo.adj)
     n = topo.n
     colors = ["y"] * n
@@ -63,7 +106,7 @@ def draw_network(
 
 def plot_routes(
     topo: Topology,
-    pos: np.ndarray,
+    pos: Optional[np.ndarray],
     servers: Sequence[int],
     job_srcs: Sequence[int],
     link_delay_sums: np.ndarray,   # (L,) per-link total realized delay

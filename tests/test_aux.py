@@ -100,6 +100,59 @@ def test_plot_routes_writes_file(tmp_path, small_cases):
     assert os.path.isfile(out)
 
 
+def test_layout_positions_cache_roundtrip(tmp_path, small_cases):
+    from multihop_offload_tpu.utils.visualization import layout_positions
+
+    rec = small_cases[0]
+    cache = str(tmp_path / "pos")
+    a = layout_positions(rec.topo, case_name="c0", cache_dir=cache)
+    assert a.shape == (rec.topo.n, 2)
+    cache_file = os.path.join(cache, "graph_c_pos_c0.npy")
+    assert os.path.isfile(cache_file)
+    # second call must come from the cache, not a recompute
+    np.save(cache_file, a + 7.0)
+    b = layout_positions(rec.topo, case_name="c0", cache_dir=cache)
+    np.testing.assert_array_equal(b, a + 7.0)
+    # explicit array passes through; 'new' bypasses the cache
+    np.testing.assert_array_equal(
+        layout_positions(rec.topo, pos=a, case_name="c0", cache_dir=cache), a
+    )
+    fresh = layout_positions(rec.topo, pos="new", case_name="c0", cache_dir=cache)
+    assert fresh.shape == (rec.topo.n, 2)
+    with pytest.raises(ValueError):
+        layout_positions(rec.topo, pos="bogus")
+
+
+def test_plot_routes_geometry_free(tmp_path, small_cases):
+    """BA/ER/WS cases carry no coordinates; pos=None must still render
+    (reference node_positions, offloading_v3.py:152-165)."""
+    from multihop_offload_tpu.utils.visualization import plot_routes
+
+    rec = small_cases[0]
+    out = plot_routes(
+        rec.topo, None, np.flatnonzero(rec.roles == 1),
+        rec.mobile_nodes[:3],
+        np.random.default_rng(0).uniform(0, 5, rec.topo.num_links),
+        np.zeros(rec.topo.n),
+        str(tmp_path / "fig" / "routes_nopos.png"),
+    )
+    assert os.path.isfile(out)
+
+
+def test_route_demo_cli(tmp_path, small_cases):
+    from conftest import REFERENCE_DATA
+
+    from multihop_offload_tpu.cli.plot import route_demo
+
+    rec = small_cases[0]
+    out = route_demo(
+        os.path.join(REFERENCE_DATA, rec.filename),
+        str(tmp_path / "fig"), pos_cache=str(tmp_path / "pos"),
+    )
+    assert os.path.isfile(out)
+    assert any(f.endswith(".npy") for f in os.listdir(tmp_path / "pos"))
+
+
 def test_phase_timers():
     reset_phases()
     with phase_timer("x"):
