@@ -1,0 +1,185 @@
+"""Reconcile XLA cost_analysis FLOPs vs the hand count — VERDICT r4 weak #1.
+
+`BENCH_r04.json` reported `flops_per_step` 1.42e9 (XLA cost_analysis on the
+compiled step) vs `flops_per_step_hand` 5.95e9 (analytic), a 4.2x gap with no
+explanation.  This script pins the cause by compiling each step component at
+the exact bench shapes and comparing cost_analysis against the analytic
+count for that component alone:
+
+  * APSP min-plus squaring at trip counts 1 vs 7 (fori_loop) and the
+    early-stop while_loop — does cost_analysis scale with the trip count or
+    charge the loop body once?
+  * the 10-iteration interference fixed point (lax.scan) at 1 vs 10 steps;
+  * the ChebNet actor forward (the MXU matmuls);
+  * the full forward_backward step, early-stop on and off.
+
+Writes `benchmarks/flops_reconcile.json`; `benchmarks/README.md` states
+which count MFU uses and why.  Pinned to the CPU backend via jax.config
+(the counts are HLO-level; this host's sitecustomize captures JAX_PLATFORMS
+before scripts run, and compiling on the tunneled chip would contend with
+any bench running there).
+
+Usage: python scripts/flops_reconcile.py
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+OUT = os.path.join(REPO, "benchmarks", "flops_reconcile.json")
+
+
+def compiled_flops(fn, *args):
+    import jax
+
+    compiled = jax.jit(fn).lower(*args).compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return float(ca.get("flops", 0.0))
+
+
+def main() -> int:
+    import functools
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from bench import build_bench_batch, _hand_flop_count
+    from multihop_offload_tpu.agent import forward_backward
+    from multihop_offload_tpu.agent.actor import actor_delay_matrix, default_support
+    from multihop_offload_tpu.env.apsp import apsp_minplus
+    from multihop_offload_tpu.env.queueing import interference_fixed_point_raw
+
+    model, variables, binst, bjobs, pad, batch = build_bench_batch()
+    n, l, e = pad.n, pad.l, pad.e
+    rows = {}
+
+    # --- APSP: trip-count scaling ---------------------------------------
+    w = jnp.where(
+        binst.adj > 0, 1.0 / jnp.maximum(binst.link_rates[
+            jnp.arange(batch)[:, None, None], binst.link_index], 1e-9),
+        jnp.inf,
+    ).astype(jnp.float32)
+    iters = max(1, math.ceil(math.log2(max(n - 1, 2))))
+
+    def apsp_k(k):
+        return compiled_flops(
+            jax.vmap(functools.partial(
+                apsp_minplus, num_iters=k, early_stop=False)), w)
+
+    f1, fk = apsp_k(1), apsp_k(iters)
+    f_while = compiled_flops(
+        jax.vmap(functools.partial(apsp_minplus, early_stop=True)), w)
+    rows["apsp"] = {
+        "shape": f"batch={batch} N={n}", "static_iters": iters,
+        "flops_iters1": f1, f"flops_iters{iters}": fk,
+        "flops_while_loop": f_while,
+        "scaling_ratio": round(fk / f1, 2) if f1 else None,
+        "hand_2N3_per_iter": 2.0 * batch * n**3,
+        "verdict": ("cost_analysis charges fori_loop bodies ONCE"
+                    if f1 and fk / f1 < 1.5 else
+                    "cost_analysis scales with trip count"),
+        "while_vs_static": round(f_while / fk, 2) if fk else None,
+    }
+
+    # --- fixed point: scan scaling --------------------------------------
+    lam = jnp.abs(jnp.ones((batch, l), jnp.float32)) * 0.01
+
+    def fp_k(k):
+        return compiled_flops(
+            jax.vmap(lambda a, r, c, x: interference_fixed_point_raw(
+                a, r, c, x, num_iters=k)),
+            binst.adj_conflict, binst.link_rates, binst.cf_degs, lam)
+
+    g1, g10 = fp_k(1), fp_k(10)
+    rows["fixed_point"] = {
+        "shape": f"batch={batch} L={l}",
+        "flops_iters1": g1, "flops_iters10": g10,
+        "scaling_ratio": round(g10 / g1, 2) if g1 else None,
+        "hand_2L2_per_iter": 2.0 * batch * l * l,
+        "verdict": ("cost_analysis charges scan bodies ONCE"
+                    if g1 and g10 / g1 < 1.5 else
+                    "cost_analysis scales with scan length"),
+    }
+
+    # --- actor forward (ChebNet matmuls) --------------------------------
+    support = default_support(model, jax.tree_util.tree_map(
+        lambda x: x[0], binst))
+
+    def actor_fwd(v, inst, jobs):
+        return actor_delay_matrix(model, v, inst, jobs, support).delay_matrix
+
+    f_actor = compiled_flops(
+        jax.vmap(lambda i, j: actor_fwd(variables, i, j)), binst, bjobs)
+    width = [4] + [32] * 4 + [1]
+    hand_cheb = sum(2.0 * e * e * f for f in width[:-1]) * batch
+    rows["actor_forward"] = {
+        "shape": f"batch={batch} E={e}",
+        "flops": f_actor, "hand_cheb_fwd": hand_cheb,
+        "ratio_measured_over_hand": round(f_actor / hand_cheb, 3)
+        if hand_cheb else None,
+    }
+
+    # --- full step, early on/off ----------------------------------------
+    keys = jax.random.split(jax.random.PRNGKey(1), batch)
+
+    def full(early):
+        ap = None if early else functools.partial(
+            apsp_minplus, early_stop=False)
+
+        def step(v, insts, jobs, ks):
+            outs = jax.vmap(lambda i, jb, k: forward_backward(
+                model, v, i, jb, k, explore=0.0, apsp_fn=ap))(insts, jobs, ks)
+            return outs.grads, outs.loss_critic
+
+        return compiled_flops(step, variables, binst, bjobs, keys)
+
+    fe, fs = full(True), full(False)
+    from bench import _loop_corrected_flops
+
+    hand = _hand_flop_count(n, l, e, batch)
+    corrected = _loop_corrected_flops(fs, n, l, batch)
+    rows["full_step"] = {
+        "flops_early_stop": fe, "flops_static": fs,
+        "flops_loop_corrected": corrected,
+        "hand": hand,
+        "hand_over_measured_static": round(hand / fs, 2) if fs else None,
+        "hand_over_corrected": round(hand / corrected, 2) if corrected else None,
+    }
+
+    platform = jax.default_backend()
+    rec = {
+        "platform": platform,
+        "components": rows,
+        "conclusion": None,  # filled below from the measurements
+    }
+    apsp_once = rows["apsp"]["scaling_ratio"] and rows["apsp"]["scaling_ratio"] < 1.5
+    fp_once = rows["fixed_point"]["scaling_ratio"] and rows["fixed_point"]["scaling_ratio"] < 1.5
+    parts = []
+    if apsp_once:
+        parts.append(
+            f"cost_analysis charges the APSP fori_loop body once instead of "
+            f"{iters}x (undercount ~{(iters - 1) * 2.0 * batch * n**3:.3g} flops)")
+    if fp_once:
+        parts.append(
+            "and the 10-step fixed-point scan once instead of 10x")
+    rec["conclusion"] = (
+        "; ".join(parts) if parts else
+        "loop bodies are fully counted; discrepancy lies elsewhere")
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "w") as f:
+        json.dump(rec, f, indent=1)
+    print(json.dumps(rec, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
