@@ -79,11 +79,14 @@ def fixed_point_path(interpret: bool = False) -> str:
     return "pallas" if tpu_backend() else "xla-fallback"
 
 
-# Measured crossover (`benchmarks/pallas_tpu.json`): the VMEM-resident kernel
-# wins 2.44x at padded L=256 (the production bench batch shape) and ties XLA
-# at L=512.  'auto' takes Pallas only where a WIN is measured; unmeasured
-# shapes (384) and the tie default to XLA.
-_AUTO_FP_MAX_L = 256
+# Measured crossover, round-5 evidence set: IN-STEP (the authoritative
+# signal — `benchmarks/fp_ab.json`, 200-rep idle-host legs) the kernel wins
+# 1.16x at the production padded L=256; the isolated microbench rungs
+# (`pallas_tpu.json` l256/l384/l512: 0.81/0.94/1.13x) sit on the tunnel's
+# ~4ms dispatch floor and understate it, trending monotonically UP with L.
+# 'auto' therefore takes Pallas through the measured ladder top (512);
+# beyond is unmeasured and defaults to XLA.
+_AUTO_FP_MAX_L = 512
 
 
 def auto_fp_path(l: int, interpret: bool = False) -> str:

@@ -98,10 +98,13 @@ _MAX_SQUARING_N = 256  # largest padded size where the whole-matrix VMEM
 _MAX_BLOCKED_N = 2048  # blocked-FW ceiling: above this the (B, N, N) HBM
 #                        residency and per-call latency favor the
 #                        ring-sharded APSP (`parallel.ring`) across chips.
-_AUTO_PALLAS_MIN_N = 512  # measured crossover on a real v5e chip
-#                        (benchmarks/pallas_tpu.json): XLA squaring beats the
-#                        Pallas kernels up to padded N=384 (0.62-0.97x); the
-#                        blocked FW wins from 512 (2.43x) through 1024
+_AUTO_PALLAS_MIN_N = 256  # measured crossover on a real v5e chip
+#                        (benchmarks/pallas_tpu.json, round-5 re-ladder of
+#                        the sublane-chunked squaring rework): XLA wins only
+#                        below padded N=256; the chunked squaring kernel
+#                        wins at 256 (1.12x), blocked FW from 384 (1.29x),
+#                        2.48x at 512, 4.33x at 1024.  The pre-rework kernel
+#                        lost 0.62-0.63x at 128-256, hence the old 512 floor.
 #                        (4.93x).  `apsp_impl='auto'` dispatches on this;
 #                        'pallas' forces the kernel regardless (proof runs).
 
@@ -318,8 +321,9 @@ def resolve_apsp(impl: str, n: int, interpret: bool = False):
     Returns ``(apsp_fn, path)``.  ``apsp_fn`` is None for the default XLA
     min-plus squaring (callers treat None as `env.apsp.apsp_minplus`).
     'auto' picks the fastest measured path per call shape
-    (`benchmarks/pallas_tpu.json`: XLA below padded N=512, blocked FW
-    above); 'pallas' forces `apsp_minplus_pallas`, which self-dispatches
+    (`benchmarks/pallas_tpu.json` round-5 re-ladder: XLA below padded
+    N=256, chunked squaring at 256, blocked FW from 384);
+    'pallas' forces `apsp_minplus_pallas`, which self-dispatches
     (squaring <= 256, blocked FW <= 2048, XLA beyond / off-TPU).  ``path``
     is the resolution REPORT for size ``n`` ('xla' | 'squaring' |
     'blocked-fw' | 'xla-fallback'); other bucket sizes may resolve

@@ -45,6 +45,9 @@ STEPS = [
     ("apsp_n512", "apsp", 512, 2),
     ("apsp_n1024", "apsp", 1024, 1),    # ~1000-node case (blocked FW)
     ("fixedpoint_l256_b64", "fp", 256, 64),   # bench-shape conflict graphs
+    ("fixedpoint_l384_b32", "fp", 384, 32),   # bigger-network pad bucket —
+    #                                           the rung 'auto' interpolated
+    #                                           across until round 5
     ("fixedpoint_l512_b16", "fp", 512, 16),
 ]
 

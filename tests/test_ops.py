@@ -227,15 +227,17 @@ def test_blocked_fw_matches_xla_beyond_squaring_cap():
 
 def test_auto_apsp_follows_measured_crossover():
     """`apsp_impl='auto'` must pick the fastest MEASURED implementation per
-    shape (benchmarks/pallas_tpu.json: XLA wins to padded N=384, blocked FW
-    from 512) — not 'pallas whenever on TPU' (the pre-crossover policy)."""
+    shape (benchmarks/pallas_tpu.json round-5 re-ladder: XLA wins below
+    padded N=256, chunked squaring at 256, blocked FW from 384) — not
+    'pallas whenever on TPU' (the pre-crossover policy)."""
     from multihop_offload_tpu.ops.minplus import (
         apsp_minplus_auto, auto_apsp_path, resolve_apsp,
     )
 
     # below the crossover auto = XLA regardless of backend
     assert auto_apsp_path(110, interpret=True) == "xla"
-    assert auto_apsp_path(384, interpret=True) == "xla"
+    assert auto_apsp_path(256, interpret=True) == "squaring"
+    assert auto_apsp_path(384, interpret=True) == "blocked-fw"
     assert auto_apsp_path(512, interpret=True) == "blocked-fw"
     assert auto_apsp_path(1000, interpret=True) == "blocked-fw"
     assert auto_apsp_path(3000, interpret=True) == "xla-fallback"
@@ -316,9 +318,13 @@ def test_resolve_fixed_point_paths():
 
     fn, path = resolve_fixed_point("xla", 256)
     assert fn is None and path == "xla"
-    # beyond the measured win (L=512 ties XLA on chip): direct XLA
-    fn, path = resolve_fixed_point("auto", 512)
+    # beyond the measured ladder top (512): direct XLA
+    fn, path = resolve_fixed_point("auto", 640)
     assert fn is None and path == "xla"
+    # L=512 is inside the round-5 measured win; off-TPU it still resolves
+    # to the honest fallback path
+    fn, path = resolve_fixed_point("auto", 512)
+    assert fn is None and path == "xla-fallback"
     # inside the measured win but suite runs on CPU: direct XLA, honest path
     fn, path = resolve_fixed_point("auto", 200)
     assert fn is None and path == "xla-fallback"
