@@ -1,0 +1,84 @@
+"""Sequential on-chip bench matrix — the round-5 knob bisect.
+
+One bounded chip job that runs `bench.py` over a grid of
+{APSP early-stop on/off} x {fixed-point xla/pallas} with repeats, strictly
+sequentially on an otherwise idle host, and writes every JSON line to
+`benchmarks/bench_matrix_r05.json`.  Motivated by two round-5 observations:
+(a) `fp_ab.json` showed fp_impl=pallas LOSING 4x in the production step
+despite its 2.44x microbenchmark win, and (b) two identical-config runs
+differed 3.7x — so single runs on this tunneled chip cannot decide a knob.
+
+Usage: python scripts/bench_matrix.py [reps]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "benchmarks", "bench_matrix_r05.json")
+
+CONFIGS = [
+    {"name": "early1_fpxla", "BENCH_APSP_EARLY": "1", "BENCH_FP_IMPL": "xla"},
+    {"name": "early0_fpxla", "BENCH_APSP_EARLY": "0", "BENCH_FP_IMPL": "xla"},
+    {"name": "early1_fppallas", "BENCH_APSP_EARLY": "1", "BENCH_FP_IMPL": "pallas"},
+    {"name": "early0_fppallas", "BENCH_APSP_EARLY": "0", "BENCH_FP_IMPL": "pallas"},
+]
+
+
+def main() -> int:
+    sys.path.insert(0, REPO)
+    from multihop_offload_tpu.utils.subproc import last_json_line
+
+    reps = int(sys.argv[1]) if len(sys.argv) > 1 else 2
+    runs = []
+    for r in range(reps):
+        for cfg in CONFIGS:
+            env = dict(os.environ)
+            env.update({k: v for k, v in cfg.items() if k != "name"})
+            res = subprocess.run(
+                [sys.executable, os.path.join(REPO, "bench.py")],
+                env=env, capture_output=True, text=True, cwd=REPO,
+            )
+            rec = last_json_line(res.stdout)
+            row = {"config": cfg["name"], "rep": r}
+            if rec is None:
+                row["error"] = " | ".join(
+                    (res.stderr or res.stdout).strip().splitlines()[-2:])
+            else:
+                row.update({
+                    "eps": rec.get("value"),
+                    "platform": rec.get("platform"),
+                    "apsp_path": rec.get("apsp_path"),
+                    "fp_path": rec.get("fp_path"),
+                    "mfu": (rec.get("roofline") or {}).get("mfu"),
+                })
+            runs.append(row)
+            print(json.dumps(row), flush=True)
+            with open(OUT, "w") as f:  # checkpoint after every leg
+                json.dump({"runs": runs}, f, indent=1)
+
+    # summarize: per-config mean of TPU-platform legs only
+    summary = {}
+    for cfg in CONFIGS:
+        vals = [x["eps"] for x in runs
+                if x["config"] == cfg["name"] and x.get("platform") == "tpu"
+                and x.get("eps")]
+        if vals:
+            summary[cfg["name"]] = {
+                "mean_eps": round(sum(vals) / len(vals), 1),
+                "min_eps": round(min(vals), 1),
+                "max_eps": round(max(vals), 1),
+                "n": len(vals),
+            }
+    with open(OUT, "w") as f:
+        json.dump({"runs": runs, "summary_tpu": summary}, f, indent=1)
+    print(json.dumps(summary, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -86,6 +86,9 @@ def main() -> int:
                          "models (best = rolling-tau best, training/README)")
     ap.add_argument("--cheb_k", type=int, default=1,
                     help="Chebyshev order of the evaluated checkpoint")
+    ap.add_argument("--seed", type=int, default=7,
+                    help="workload sampling seed (replicate studies vary "
+                         "this; the reference's workloads are unseeded)")
     args = ap.parse_args()
     ref_csv = os.path.join(
         REF, "out",
@@ -103,7 +106,7 @@ def main() -> int:
         training_set=args.training_set,
         model_root=args.model_root,
         dtype=args.dtype,
-        seed=7,
+        seed=args.seed,
         compat_diagonal_bug=args.compat_diagonal_bug,
         pad_buckets=args.pad_buckets,
         cheb_k=args.cheb_k,
