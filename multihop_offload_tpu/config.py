@@ -110,6 +110,12 @@ class Config:
     #                                present), 1 = force single-device, N =
     #                                explicit axis size
     mesh_graph: int = 1            # graph-partition (ring APSP) axis size
+    csv_write_all_hosts: bool = False  # multi-process runs: every process
+    #                                writes its own (shard) CSV instead of
+    #                                gating on process_index()==0 — used by
+    #                                per-process file-sharded evaluation
+    #                                (scripts/multiprocess_eval.py); keep
+    #                                False when all hosts share one out dir
     model_root: str = "model"      # parent dir of checkpoint directories
     tb_logdir: str = ""            # TensorBoard scalars ("" = disabled); the
     #                                working version of the reference's

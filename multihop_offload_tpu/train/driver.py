@@ -675,6 +675,20 @@ class Evaluator(_Harness):
         sharded over devices (the file-DP path visits bucket-by-bucket)."""
         return np.random.default_rng((self.cfg.seed, fid))
 
+    def _file_keys(self, fid: int) -> jnp.ndarray:
+        """Per-file eval PRNG keys, keyed on (seed, fid) like `_file_rng`.
+
+        The harness-level `next_keys` stream is call-order-dependent, which
+        would break the sharded == sequential guarantee for policies that
+        actually consume their key (cfg.prob=True or explore>0) — with the
+        default deterministic argmin the key is unused either way.  Keying
+        on fid makes the equality structural for every mode and every
+        sharding (`file_ids` shards, the file-DP chunks, sequential)."""
+        base = jax.random.fold_in(
+            jax.random.PRNGKey(self.cfg.seed), np.uint32(fid)
+        )
+        return jax.random.split(base, self.cfg.num_instances)
+
     def _build_file(self, fid: int):
         """Host-side per-file prep — the ONE definition of the workload
         draw for file `fid`, shared by the sequential and file-DP eval
@@ -693,7 +707,17 @@ class Evaluator(_Harness):
         return (rec, inst, jobsets, counts), time.time() - t0
 
     def run(self, files_limit: Optional[int] = None, out_dir: Optional[str] = None,
-            verbose: bool = True):
+            verbose: bool = True, file_ids=None):
+        """Evaluate the test set; write the reference-schema CSV.
+
+        `file_ids`: optional explicit file-id subset (e.g. ``range(p, n, 2)``
+        for process p of a 2-process file shard — `scripts/multiprocess_eval
+        .py`).  The per-file workload RNG (`_file_rng`) keys on fid alone, so
+        any sharding realizes workloads identical to the sequential sweep.
+        Subset runs take the sequential per-file path (the file-DP chunked
+        path batches whole buckets and is pointless on a strict subset);
+        `csv_write_all_hosts` lets non-zero processes write their shard CSV.
+        """
         cfg = self.cfg
         out_dir = out_dir or cfg.out
         os.makedirs(out_dir, exist_ok=True)
@@ -703,18 +727,24 @@ class Evaluator(_Harness):
             f"Adhoc_test_data_{dataset_tag}_load_{cfg.arrival_scale:.2f}_T_{cfg.T}.csv",
         )
         n_files = min(len(self.data), files_limit or len(self.data))
+        write_csv = self.is_host0 or cfg.csv_write_all_hosts
 
         def flush(rows):
             # file-DP path: rows back-fill out of order -> full rewrite
-            if self.is_host0:
+            if write_csv:
                 pd.DataFrame(rows, columns=TEST_COLUMNS).to_csv(
                     csv_path, index=False
                 )
 
-        if self.eval_chunk > 1:
+        if file_ids is None and self.eval_chunk > 1:
             self._run_files_dp(n_files, verbose, flush)
         else:
-            eval_csv = _CsvFlusher(csv_path, TEST_COLUMNS, enabled=self.is_host0)
+            # file_ids composes with files_limit: ids outside the (possibly
+            # limited) file range are dropped, mirroring the sequential
+            # clamp — an oversized shard spec must not IndexError mid-sweep
+            fids = ([f for f in file_ids if 0 <= f < n_files]
+                    if file_ids is not None else list(range(n_files)))
+            eval_csv = _CsvFlusher(csv_path, TEST_COLUMNS, enabled=write_csv)
             rows = []
             # one-file host/device pipeline (`_Prefetcher`, cfg.prefetch):
             # jax dispatch is async, so the NEXT file's host build runs
@@ -726,12 +756,12 @@ class Evaluator(_Harness):
             # (`AdHoc_test.py:126`); the subtraction is exact when host and
             # device serialize (single-core CPU) and underestimates when a
             # true-overlap build outlasts the device step.
-            pf = _Prefetcher(range(n_files), self._build_file, cfg.prefetch)
-            for fid in range(n_files):
+            pf = _Prefetcher(fids, self._build_file, cfg.prefetch)
+            for i, fid in enumerate(fids):
                 rec, inst, jobsets, counts = pf.current()
                 t0 = time.time()
                 bl, loc, gnn = self._eval_methods(
-                    self.variables, inst, jobsets, self.next_keys(cfg.num_instances)
+                    self.variables, inst, jobsets, self._file_keys(fid)
                 )
                 next_build_s = pf.prefetch_next()
                 jax.block_until_ready(gnn)
@@ -743,8 +773,8 @@ class Evaluator(_Harness):
                 )
                 rows += _rows(rec, counts, metrics, runtime, fid,
                               algo_col="Algo", fid_col=False)
-                if verbose and fid % 50 == 0:
-                    print(f"[{fid + 1}/{n_files}] {rec.filename} "
+                if verbose and i % 50 == 0:
+                    print(f"[{i + 1}/{len(fids)}] {rec.filename} "
                           f"({wall:.3f}s for {3 * cfg.num_instances} evals)")
                 eval_csv.flush(rows)
                 pf.raise_deferred()
@@ -797,9 +827,10 @@ class Evaluator(_Harness):
         for bucket, chunk in chunks:
             binst, bjobs, jsets, cnts = pf.current()
             real = len(chunk)
-            keys = self.next_keys(
-                self.eval_chunk * cfg.num_instances
-            ).reshape(self.eval_chunk, cfg.num_instances, -1)
+            # per-file keys (pad slots reuse the last real file's keys —
+            # their rows are dropped, and no extra draws may occur)
+            padded = list(chunk) + [chunk[-1]] * (self.eval_chunk - real)
+            keys = jnp.stack([self._file_keys(f) for f in padded])
             t0 = time.time()
             bl, loc, gnn = self._eval_files_dp(
                 self.variables, binst, bjobs, keys

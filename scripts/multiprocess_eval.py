@@ -1,0 +1,167 @@
+"""Two-process file-sharded Evaluator record — VERDICT r4 ask #8.
+
+The only multi-process END-TO-END evidence this single-host environment can
+produce: two OS processes join a real `jax.distributed` session (the
+coordinator path of `parallel.mesh.init_distributed` — the framework's
+NCCL/MPI-equivalent bring-up, exercised by `tests/test_multiprocess.py`),
+shard the reference test set's files between them (process p takes files
+p::2), and each runs the Evaluator over its shard, writing a per-process
+CSV (`csv_write_all_hosts`).  The parent then runs the SAME files in one
+sequential process and asserts the merged shard rows are IDENTICAL on every
+result column — the per-file workload RNG (`Evaluator._file_rng`, keyed on
+(seed, fid)) makes sharded == sequential by construction, and this record
+proves it end-to-end across real process boundaries.
+
+Writes `benchmarks/multiprocess_eval.json`.  Wall-clock fields are recorded
+honestly but are NOT a speedup claim: this host has one core, so two
+processes time-slice it.
+
+Usage: python scripts/multiprocess_eval.py [--files 60]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "benchmarks", "multiprocess_eval.json")
+
+_CHILD = r'''
+import os, sys, time
+sys.path.insert(0, os.environ["MHO_REPO"])
+import jax
+jax.config.update("jax_platforms", "cpu")
+from multihop_offload_tpu.parallel.mesh import init_distributed
+
+pid = int(sys.argv[1])
+n_files = int(sys.argv[2])
+init_distributed(coordinator_address=os.environ["MHO_COORD"],
+                 num_processes=2, process_id=pid)
+assert jax.process_index() == pid
+
+from multihop_offload_tpu.config import Config
+from multihop_offload_tpu.train.driver import Evaluator
+
+cfg = Config(
+    datapath="/root/reference/data/aco_data_ba_100",
+    out=os.path.join(os.environ["MHO_OUT"], f"proc{pid}"),
+    T=1000, arrival_scale=0.15, training_set="BAT800",
+    model_root="/root/reference/model", dtype="float32", seed=7,
+    mesh_data=1, file_batch=1, csv_write_all_hosts=True,
+)
+ev = Evaluator(cfg)
+t0 = time.time()
+csv = ev.run(file_ids=range(pid, n_files, 2), verbose=False)
+print(f"PROC {pid} DONE wall={time.time()-t0:.1f} csv={csv}", flush=True)
+'''
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--files", type=int, default=60)
+    ap.add_argument("--timeout", type=int, default=1800)
+    args = ap.parse_args()
+
+    shard_out = "/tmp/mp_eval"
+    os.makedirs(shard_out, exist_ok=True)
+    env = {**os.environ, "MHO_REPO": REPO, "MHO_OUT": shard_out,
+           "MHO_COORD": f"127.0.0.1:{_free_port()}",
+           "JAX_PLATFORMS": "", "XLA_FLAGS": ""}
+    t0 = time.time()
+    procs = [
+        subprocess.Popen([sys.executable, "-c", _CHILD, str(p),
+                          str(args.files)],
+                         env=env, stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT)
+        for p in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=args.timeout)
+        outs.append(out.decode())
+    two_proc_wall = time.time() - t0
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        if p.returncode != 0 or f"PROC {i} DONE" not in out:
+            print(f"proc {i} FAILED rc={p.returncode}:\n{out[-2000:]}",
+                  file=sys.stderr)
+            return 1
+
+    # sequential single-process run over the same files, same seed
+    import pandas as pd
+
+    sys.path.insert(0, REPO)
+    from multihop_offload_tpu.utils.platform import apply_platform_env
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    apply_platform_env()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from multihop_offload_tpu.config import Config
+    from multihop_offload_tpu.train.driver import Evaluator
+
+    cfg = Config(
+        datapath="/root/reference/data/aco_data_ba_100",
+        out=os.path.join(shard_out, "seq"),
+        T=1000, arrival_scale=0.15, training_set="BAT800",
+        model_root="/root/reference/model", dtype="float32", seed=7,
+        mesh_data=1, file_batch=1,
+    )
+    t0 = time.time()
+    seq_csv = Evaluator(cfg).run(files_limit=args.files, verbose=False)
+    seq_wall = time.time() - t0
+
+    name = os.path.basename(seq_csv)
+    shards = pd.concat([
+        pd.read_csv(os.path.join(shard_out, f"proc{p}", name))
+        for p in range(2)
+    ])
+    seq = pd.read_csv(seq_csv)
+    key = ["filename", "n_instance", "Algo"]
+    result_cols = [c for c in seq.columns if c != "runtime"]  # timing varies
+    a = shards[result_cols].sort_values(key).reset_index(drop=True)
+    b = seq[result_cols].sort_values(key).reset_index(drop=True)
+    identical = a.equals(b)
+
+    rec = {
+        "description": "two coordinator-joined processes shard the test "
+                       "set's files (p::2 each) and run the Evaluator "
+                       "end-to-end; merged shard rows vs one sequential "
+                       "process over the same files",
+        "files": args.files,
+        "rows_per_run": int(len(seq)),
+        "rows_identical_excl_runtime": bool(identical),
+        "two_process_wall_s": round(two_proc_wall, 1),
+        "sequential_wall_s": round(seq_wall, 1),
+        "note": "single-core host: the two processes time-slice one CPU, "
+                "so wall-clock is NOT a speedup claim; the record proves "
+                "distributed bring-up + bit-equal file sharding end-to-end",
+        "child_logs": [o.strip().splitlines()[-1] for o in outs],
+    }
+    if not identical:
+        if len(a) == len(b):
+            diff = (a != b).any(axis=0)
+            rec["differing_columns"] = [c for c in result_cols if bool(diff[c])]
+        else:
+            rec["row_count_mismatch"] = {"shards": int(len(a)),
+                                         "sequential": int(len(b))}
+    with open(OUT, "w") as f:
+        json.dump(rec, f, indent=1)
+    print(json.dumps(rec, indent=1))
+    return 0 if identical else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

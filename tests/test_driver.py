@@ -310,3 +310,32 @@ def test_csv_flusher_append_equals_rewrite(tmp_path):
         fl.flush(rows)
         pd.DataFrame(rows, columns=cols).to_csv(p_old, index=False)
     assert open(p_new, "rb").read() == open(p_old, "rb").read()
+
+
+def test_file_ids_shard_matches_sequential(tmp_path, tiny_dataset, monkeypatch):
+    """Explicit `file_ids` shards (the two-process file-sharding unit,
+    scripts/multiprocess_eval.py) merged together must be bit-equal to the
+    sequential sweep over the same files — `_file_rng` keys workloads on
+    (seed, fid) alone, so sharding cannot change any realized workload."""
+    monkeypatch.chdir(tmp_path)
+    cols = ["filename", "n_instance", "Algo", "tau", "congest_jobs"]
+
+    cfg = _cfg(tmp_path, tiny_dataset, mesh_data=1,
+               out=str(tmp_path / "out_seq"))
+    ev = Evaluator(cfg)
+    n = len(ev.data)
+    seq = pd.read_csv(ev.run(verbose=False))
+
+    shards = []
+    for p in range(2):
+        cfg_p = _cfg(tmp_path, tiny_dataset, mesh_data=1,
+                     out=str(tmp_path / f"out_p{p}"))
+        shards.append(pd.read_csv(
+            Evaluator(cfg_p).run(file_ids=range(p, n, 2), verbose=False)
+        ))
+    merged = pd.concat(shards)
+    key = ["filename", "Algo", "n_instance"]
+    pd.testing.assert_frame_equal(
+        seq.sort_values(key)[cols].reset_index(drop=True),
+        merged.sort_values(key)[cols].reset_index(drop=True),
+    )
