@@ -109,6 +109,39 @@ def trained_table(scale: str, tag: str, label: str) -> list[str]:
     ]
 
 
+def replicate_table() -> list[str]:
+    """Round-5 load-0.20 bug-compat replicate study: the published tau's
+    position in the empirical workload-sampling spread."""
+    rec = _load("replicates_load_0.20_compat.json")
+    rows = ["| workload seed | GNN mean tau (bug-compat) | pooled congestion |",
+            "|---|---|---|"]
+    n_rendered = 0
+    for r in rec["replicates"]:
+        g = r.get("GNN") or {}
+        if g.get("mean_tau") is None:
+            continue
+        rows.append(f"| {r['seed']} | {_tau(g['mean_tau'])} | "
+                    f"{_pct(g['congested_ratio'])} |")
+        n_rendered += 1
+    if not n_rendered:
+        return []
+    s = rec.get("summary") or {}
+    if s.get("n"):
+        inside = "inside" if s["published_inside_range"] else "OUTSIDE"
+        z = s.get("published_z")
+        rows.append(
+            f"| **spread (n={s['n']})** | {_tau(s['gnn_tau_min'])} - "
+            f"{_tau(s['gnn_tau_max'])} (mean {_tau(s['gnn_tau_mean'])}"
+            + (f", sd {s['gnn_tau_stdev']:.1f}" if s.get("gnn_tau_stdev") else "")
+            + f") | published {_tau(s['published_tau'])} is {inside} the range"
+            + (f" (z={z:+.2f})" if z is not None else "") + " |"
+        )
+    else:
+        rows.append("| *(study in progress — summary renders when all "
+                    "replicates land)* | | |")
+    return rows
+
+
 def baseline_quality_table() -> list[str]:
     """BASELINE.md's reference-record table, computed from the shipped CSVs."""
     import numpy as np
@@ -172,6 +205,10 @@ def blocks() -> dict[str, list[str]]:
     }
     if os.path.isdir(REF_OUT):
         out["ref_quality"] = baseline_quality_table()
+    if os.path.isfile(os.path.join(VAL, "replicates_load_0.20_compat.json")):
+        rt = replicate_table()
+        if rt:
+            out["replicates_0.20"] = rt
     return out
 
 

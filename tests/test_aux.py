@@ -203,3 +203,39 @@ def test_validation_docs_derived_from_artifacts():
         capture_output=True, text=True, timeout=300,
     )
     assert res.returncode == 0, res.stdout + res.stderr
+
+
+def test_bench_flop_helpers():
+    """The MFU denominator math: the loop correction must add exactly the
+    uncharged APSP/fixed-point passes, and the hand count must model K=1
+    ChebConv WITHOUT dense support matmuls (benchmarks/flops_reconcile.json:
+    the old 2E^2F term overcounted the actor 10x)."""
+    import math
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo)
+    from bench import _hand_flop_count, _loop_corrected_flops
+
+    n, l, e, b = 104, 200, 304, 64
+    iters = math.ceil(math.log2(n - 1))
+    corrected = _loop_corrected_flops(1.0e9, n, l, b)
+    assert corrected == 1.0e9 + (iters - 1) * 2.0 * b * n**3 \
+        + 5 * 9 * 2.0 * b * l * l
+
+    hand = _hand_flop_count(n, l, e, b, cheb_k=1)
+    # isolate the ChebConv part: K=1 must have NO E^2 support term — it
+    # sits far below even one dense support matmul over the batch
+    apsp_term = b * 2 * n**3 * iters
+    fp_term = b * 5 * 10 * 2 * l**2
+    cheb1 = hand - apsp_term - fp_term
+    assert 0 < cheb1 < b * 2 * e**2 * 32
+    # K=2 adds exactly one support propagation per layer (3x for fwd+bwd)
+    hand2 = _hand_flop_count(n, l, e, b, cheb_k=2)
+    widths = [4, 32, 32, 32, 32]
+    support = sum(2 * e**2 * f for f in widths)
+    feature = sum(
+        2 * e * fin * fout
+        for fin, fout in zip([4, 32, 32, 32, 32], [32, 32, 32, 32, 1])
+    )
+    assert hand2 - hand == b * 3 * (support + feature)
