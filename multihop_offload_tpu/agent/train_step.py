@@ -110,34 +110,31 @@ def _suffix_bias_grad(
     bias[e_k, j] = sum_{i >= k} unit[e_i] along job j's route (pseudo-link
     last), and grad_edge = d(sum bias * -grad_routes)/d unit  (`:384-409`).
     Since d bias[e_k]/d unit[e_i] = [i >= k], the contribution of job j to
-    grad_edge[e_i] is the prefix sum of -grad_routes over the route up to i —
-    one scan over the recorded step sequence.
+    grad_edge[e_i] is the prefix sum of -grad_routes over the route up to i.
+
+    Computed as gather -> `cumsum` over the step axis -> ONE batched
+    scatter-add: the only step-to-step dependence is the running sum, so a
+    log-depth cumsum replaces the round-4 `lax.scan` whose H sequential
+    (gather, scatter) pairs were latency-bound on TPU (14% of the r05
+    stage profile).  Inactive steps gather slot 0 harmlessly: masked to 0
+    before both the cumsum and the scatter.
     """
     num_jobs = jobs.src.shape[0]
     num_slots = routes.inc_ext.shape[0]
     cols = jnp.arange(num_jobs)
 
-    def step(carry, inputs):
-        cum, grad_edge = carry
-        slots, active = inputs
-        a = active.astype(grad_routes.dtype)
-        cum = cum - grad_routes[slots, cols] * a
-        grad_edge = grad_edge.at[slots, cols].add(cum * a)
-        return (cum, grad_edge), None
-
-    init = (
-        jnp.zeros((num_jobs,), grad_routes.dtype),
-        jnp.zeros((num_slots, num_jobs), grad_routes.dtype),
-    )
-    (cum, grad_edge), _ = lax.scan(
-        step, init, (routes.seq_slot, routes.seq_active)
-    )
+    a = routes.seq_active.astype(grad_routes.dtype)              # (H, J)
+    picked = grad_routes[routes.seq_slot, cols[None, :]] * a     # (H, J)
+    cum = -jnp.cumsum(picked, axis=0)                            # (H, J)
+    grad_edge = jnp.zeros((num_slots, num_jobs), grad_routes.dtype).at[
+        routes.seq_slot, jnp.broadcast_to(cols[None, :], routes.seq_slot.shape)
+    ].add(cum * a)
     # final pseudo-link step at the destination (`:390-403` first iteration
     # of the reference's reverse walk == last of the forward order)
     pseudo = inst.num_pad_links + routes.dst
-    a = jobs.mask.astype(grad_routes.dtype)
-    cum = cum - grad_routes[pseudo, cols] * a
-    grad_edge = grad_edge.at[pseudo, cols].add(cum * a)
+    am = jobs.mask.astype(grad_routes.dtype)
+    cum_end = cum[-1] - grad_routes[pseudo, cols] * am
+    grad_edge = grad_edge.at[pseudo, cols].add(cum_end * am)
     return grad_edge.sum(axis=1)                                 # (E,)
 
 
