@@ -252,9 +252,11 @@ def test_auto_apsp_follows_measured_crossover():
     _, path = resolve_apsp("pallas", 110, interpret=True)
     assert path == "squaring"
 
-    # numerics through the auto wrapper, both sides of the crossover
+    # numerics through the auto wrapper: below the crossover (xla), at the
+    # round-5 squaring boundary (256), the blocked-FW onset (384 — routed
+    # to blocked-fw since the re-ladder), and well above (512)
     rng = np.random.default_rng(11)
-    for n in (60, 512):
+    for n in (60, 256, 384, 512):
         w = _random_symmetric_weights(rng, n, p=4.0 / n)
         got = np.asarray(
             apsp_minplus_auto(jnp.asarray(w, jnp.float32), interpret=True)
