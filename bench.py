@@ -107,19 +107,23 @@ def _hand_flop_count(pad_n, pad_l, pad_e, batch, cheb_k=1, layers=5, hidden=32,
 
 
 def _loop_corrected_flops(ca_flops, pad_n, pad_l, batch, fp_iters=10,
-                          fp_sites=5):
+                          fp_sites=5, fp_path="xla"):
     """XLA cost_analysis charges fori_loop/scan/while bodies ONCE
     (measured: benchmarks/flops_reconcile.json — the 7-iteration APSP
     compiles to the same flop count as 1 iteration, and one APSP iteration
     matches the analytic 2N^3*B within 1%).  MFU therefore uses this
     corrected count: cost_analysis plus the (iters-1) uncharged APSP
-    squarings and the (fp_iters-1) uncharged fixed-point passes at each of
-    the step's ~5 fixed-point call sites."""
+    squarings plus the uncharged fixed-point work at each of the step's ~5
+    fixed-point call sites.  The fixed-point term depends on which kernel
+    compiled in: the XLA scan has its body charged once (add fp_iters-1
+    passes); the Pallas kernel lowers to a custom call whose interior
+    cost_analysis does not see at all (add all fp_iters passes)."""
     import math
 
     apsp_iters = max(1, math.ceil(math.log2(max(pad_n - 1, 2))))
     apsp_extra = (apsp_iters - 1) * 2.0 * batch * pad_n**3
-    fp_extra = fp_sites * (fp_iters - 1) * 2.0 * batch * pad_l**2
+    fp_uncharged = fp_iters if fp_path == "pallas" else fp_iters - 1
+    fp_extra = fp_sites * fp_uncharged * 2.0 * batch * pad_l**2
     return ca_flops + apsp_extra + fp_extra
 
 
@@ -258,7 +262,8 @@ def measure():
     device_kind = getattr(jax.devices()[0], "device_kind", "")
     peak = _peak_tflops(device_kind)
     flops_corrected = (
-        _loop_corrected_flops(flops_per_step, pad.n, pad.l, batch)
+        _loop_corrected_flops(flops_per_step, pad.n, pad.l, batch,
+                              fp_path=fp_path)
         if flops_per_step else None
     )
     achieved_tflops = (
@@ -293,9 +298,12 @@ def measure():
             "mfu": mfu,
             "note": "flops_per_step is raw XLA cost_analysis on the "
                     "compiled step (fwd+bwd, whole batch); cost_analysis "
-                    "charges loop bodies once, so MFU and arithmetic "
-                    "intensity use flops_per_step_corrected = raw + the "
-                    "uncharged APSP/fixed-point loop passes "
+                    "charges scan/loop bodies once and Pallas custom-call "
+                    "interiors not at all, so MFU and arithmetic intensity "
+                    "use flops_per_step_corrected = raw + the uncharged "
+                    "APSP squarings + the uncharged fixed-point passes "
+                    "(fp_iters-1 on the XLA scan leg, all fp_iters on the "
+                    "Pallas leg — see fp_path) "
                     "(benchmarks/flops_reconcile.json); peak is the chip's "
                     "published dense-matmul bf16 number",
         },

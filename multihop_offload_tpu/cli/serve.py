@@ -1,0 +1,103 @@
+"""Serving entry point — run the offloading-decision service.
+
+    python -m multihop_offload_tpu.cli.serve --serve_sizes=16,24 \
+        --serve_slots=8 --serve_requests=200 --training_set=BAT800
+
+Builds the bucket ladder from the configured traffic profile, loads the
+latest orbax checkpoint under the configured model dir when one exists
+(fresh glorot init otherwise — the service still serves, decisions are just
+untrained), then drives a synthetic closed-loop demo and prints the serving
+summary.  For the committed throughput/latency record use
+`scripts/serve_loadgen.py`; for production integration instantiate
+`serve.OffloadService` directly and call `submit`/`tick` from the request
+transport.
+"""
+
+from __future__ import annotations
+
+import json
+
+from multihop_offload_tpu.config import Config, from_args
+
+
+def build_service(cfg: Config, pool=None):
+    """Construct (service, pool) from config — shared by this CLI, the load
+    generator, and the smoke tests so every entry point wires the same way."""
+    import jax
+    import jax.numpy as jnp
+
+    from multihop_offload_tpu.models import make_model
+    from multihop_offload_tpu.serve.service import OffloadService
+    from multihop_offload_tpu.serve.workload import buckets_for_pool, case_pool
+
+    if pool is None:
+        sizes = [int(s) for s in str(cfg.serve_sizes).split(",") if s.strip()]
+        pool = case_pool(sizes, per_size=2, seed=cfg.seed)
+    buckets = buckets_for_pool(
+        pool, num_buckets=max(1, cfg.serve_buckets), round_to=cfg.round_to
+    )
+    model = make_model(cfg)
+    pad = buckets.pads[-1]
+    variables = model.init(
+        jax.random.PRNGKey(cfg.seed),
+        jnp.zeros((pad.e, 4), cfg.jnp_dtype),
+        jnp.zeros((pad.e, pad.e), cfg.jnp_dtype),
+    )
+    service = OffloadService(
+        model, variables, buckets,
+        slots=cfg.serve_slots, queue_cap=cfg.serve_queue_cap,
+        deadline_s=cfg.serve_deadline_s, seed=cfg.seed, prob=cfg.prob,
+        apsp_impl=cfg.apsp_impl, fp_impl=cfg.fp_impl,
+        dtype=cfg.jnp_dtype,
+    )
+    loaded = service.hot_reload(cfg.model_dir())
+    print("serving with "
+          + (f"checkpoint step {loaded} from {cfg.model_dir()}"
+             if loaded is not None else "fresh-init weights (no checkpoint)"))
+    return service, pool
+
+
+def main(argv=None):
+    import time
+
+    from multihop_offload_tpu.train.tb_logging import ScalarLogger
+    from multihop_offload_tpu.utils.platform import apply_platform_env
+
+    apply_platform_env()
+    cfg = from_args(argv)
+    service, pool = build_service(cfg)
+    tb = ScalarLogger(cfg.tb_logdir or None)
+
+    from multihop_offload_tpu.serve.workload import request_stream
+
+    t0 = time.monotonic()
+    stream = request_stream(
+        pool, cfg.serve_requests, seed=cfg.seed + 1,
+        arrival_scale=cfg.arrival_scale, ul=cfg.ul_data, dl=cfg.dl_data,
+        t_max=float(cfg.T),
+    )
+    # closed loop: keep the queue full, tick, refill — every refused submit
+    # is retried after the next tick (the demo has no other client to fail
+    # over to; a real deployment would shed instead)
+    pending = list(stream)
+    pending.reverse()
+    while pending or service.queue_depth:
+        while pending:
+            req = pending.pop()
+            if not service.submit(req):
+                if service.buckets.bucket_for(*req.sizes) is not None:
+                    pending.append(req)   # backpressure: retry after the tick
+                break                     # too-large: dropped for good
+        service.tick()
+        # newly trained weights are picked up between ticks, not mid-batch
+        service.hot_reload(cfg.model_dir())
+        if tb.active:
+            service.stats.log_tb(tb, service.stats.ticks, service.queue_depth)
+    tb.flush()
+    summary = service.stats.summary(wall_s=time.monotonic() - t0)
+    print(json.dumps(summary, indent=2))
+    return summary
+
+
+if __name__ == "__main__":
+    main()

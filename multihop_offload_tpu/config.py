@@ -116,6 +116,21 @@ class Config:
     #                                per-process file-sharded evaluation
     #                                (scripts/multiprocess_eval.py); keep
     #                                False when all hosts share one out dir
+    # ---- serving (serve/ subsystem; cli.serve + scripts/serve_loadgen) -----
+    serve_slots: int = 8           # requests batched per bucket per tick —
+    #                                the dispatch amortization factor (one
+    #                                fused program serves `serve_slots`
+    #                                requests)
+    serve_queue_cap: int = 64      # bounded admission queue (backpressure:
+    #                                submits beyond this are refused)
+    serve_deadline_s: float = 0.5  # degradation budget: a tick whose oldest
+    #                                pending request is older than this serves
+    #                                that batch with the analytic greedy
+    #                                baseline instead of the GNN
+    serve_buckets: int = 2         # shape buckets in the serving ladder
+    serve_sizes: str = "16,24"     # node sizes of the demo traffic pool
+    #                                (cli.serve synthetic workload)
+    serve_requests: int = 64       # demo request count (cli.serve)
     model_root: str = "model"      # parent dir of checkpoint directories
     tb_logdir: str = ""            # TensorBoard scalars ("" = disabled); the
     #                                working version of the reference's

@@ -81,12 +81,14 @@ def fixed_point_path(interpret: bool = False) -> str:
 
 # Measured crossover, round-5 evidence set: IN-STEP (the authoritative
 # signal — `benchmarks/fp_ab.json`, 200-rep idle-host legs) the kernel wins
-# 1.16x at the production padded L=256; the isolated microbench rungs
-# (`pallas_tpu.json` l256/l384/l512: 0.81/0.94/1.13x) sit on the tunnel's
-# ~4ms dispatch floor and understate it, trending monotonically UP with L.
-# 'auto' therefore takes Pallas through the measured ladder top (512);
-# beyond is unmeasured and defaults to XLA.
-_AUTO_FP_MAX_L = 512
+# 1.16x at the production padded L=256, and that is the LAST rung with an
+# in-step A/B.  L=384/512 have only isolated microbench rungs
+# (`pallas_tpu.json` l384/l512: 0.94/1.13x) sitting on the tunnel's ~4ms
+# dispatch floor — the 384 rung is an outright loss there and neither rung
+# has in-step evidence, so 'auto' stops at the measured win (256) rather
+# than extrapolating the microbench trend.  `fp_impl=pallas` remains the
+# explicit override for larger pads.
+_AUTO_FP_MAX_L = 256
 
 
 def auto_fp_path(l: int, interpret: bool = False) -> str:

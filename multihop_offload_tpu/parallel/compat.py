@@ -1,0 +1,36 @@
+"""jax version-compatibility shim for the sharding API.
+
+The sharding code targets the public `jax.shard_map` (jax >= 0.4.35) and its
+`check_vma` knob (the post-0.6 rename of `check_rep`).  Older wheels ship the
+function under `jax.experimental.shard_map` with the old kwarg name; this
+module resolves both so every call site imports ONE symbol with the new-style
+signature.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+from jax import lax as _lax
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover - depends on installed jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+if "check_vma" in inspect.signature(_shard_map).parameters:
+    shard_map = _shard_map
+else:
+    def shard_map(f, /, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map(f, **kwargs)
+
+
+if hasattr(_lax, "axis_size"):
+    axis_size = _lax.axis_size
+else:
+    def axis_size(axis_name):
+        """`lax.axis_size` predates some installed wheels; a psum of ones
+        over the axis is the canonical equivalent (static under tracing)."""
+        return _lax.psum(1, axis_name)

@@ -24,7 +24,7 @@ import jax.numpy as jnp
 import optax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from multihop_offload_tpu.parallel.compat import shard_map
 
 from multihop_offload_tpu.agent.replay import (
     apply_max_norm_constraint,

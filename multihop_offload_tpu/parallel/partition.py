@@ -24,6 +24,8 @@ from typing import Callable
 import jax.numpy as jnp
 from jax import lax
 
+from multihop_offload_tpu.parallel.compat import axis_size
+
 
 def halo_matmul(axis_name: str) -> Callable:
     """(rows, L) x (L_local, ...) propagation op: gather the sharded
@@ -89,7 +91,7 @@ def sharded_spectral_forward(
     inputs replicated on `axis_name`): slice this device's rows, run the
     sharded forward, regather the output."""
     e = feats.shape[0]
-    n_dev = lax.axis_size(axis_name)
+    n_dev = axis_size(axis_name)
     if e % n_dev:
         raise ValueError(
             f"graph size {e} not divisible by axis '{axis_name}' ({n_dev} "

@@ -20,6 +20,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from multihop_offload_tpu.parallel.compat import axis_size
+
 
 def _block_minplus(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """(n, k) x (k, m) min-plus product."""
@@ -31,7 +33,7 @@ def ring_minplus_square(d_rows: jnp.ndarray, axis_name: str) -> jnp.ndarray:
     (n_local, N) block.  n_dev ring steps; step s works on the row block
     originally owned by (idx + s) mod n_dev while the next block is in
     flight."""
-    n_dev = lax.axis_size(axis_name)
+    n_dev = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     n_local = d_rows.shape[0]
     perm = [(i, (i - 1) % n_dev) for i in range(n_dev)]
@@ -79,7 +81,7 @@ def sharded_apsp(w: jnp.ndarray, axis_name: str) -> jnp.ndarray:
     shards the graph).  N must be divisible by the axis size.
     """
     n = w.shape[-1]
-    n_dev = lax.axis_size(axis_name)
+    n_dev = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     n_local = n // n_dev
     start = (idx * n_local).astype(jnp.int32)

@@ -1,0 +1,27 @@
+"""On-device batched offloading-decision service.
+
+The train/eval drivers answer "which server should this task offload to?"
+one instance chunk at a time; `serve/` answers it as a SERVICE: an admission
+queue accepts graph-instance requests, a shape-bucket batcher pads and packs
+them into the static slot layout of `graphs.instance`, a device-resident
+executor runs ONE fused jitted program per tick per bucket (actor forward +
+delay head + offloading decision + route trace — the same
+`agent.policy.forward_env` the Evaluator runs), and a demultiplexer returns
+per-request decisions.  Around the core: orbax checkpoint hot-load,
+bounded-queue backpressure with analytic-baseline degradation, and a
+serving-metrics surface (occupancy, padding waste, queue depth, latency
+quantiles, dispatches/request).
+"""
+
+from multihop_offload_tpu.serve.request import (  # noqa: F401
+    OffloadRequest,
+    OffloadResponse,
+)
+from multihop_offload_tpu.serve.bucketing import ShapeBuckets, pack_bucket  # noqa: F401
+from multihop_offload_tpu.serve.executor import BucketExecutor  # noqa: F401
+from multihop_offload_tpu.serve.metrics import ServingStats  # noqa: F401
+from multihop_offload_tpu.serve.service import OffloadService  # noqa: F401
+from multihop_offload_tpu.serve.workload import (  # noqa: F401
+    request_stream,
+    synthetic_case,
+)

@@ -1,0 +1,125 @@
+"""Shape-bucket batching: pack irregular requests into static slot layouts.
+
+XLA compiles one executable per input shape, so a service over irregular
+graphs must quantize request sizes into a small set of pad shapes (the
+GNN-on-TPU benchmarking playbook, arXiv:2210.12247): each bucket is a
+`PadSpec` and every request is padded up to the SMALLEST bucket that fits
+it.  The number of compiled programs is then `len(buckets)` per policy —
+fixed at configuration time, never per-request — and the padding waste is
+bounded by the bucket spacing.
+
+`pack_bucket` reuses the drivers' exact pipeline primitives
+(`build_instance(device=False)` + `stack_instances`: one device transfer
+per leaf for the whole batch) and the file-DP Evaluator's pad rule for
+partially-filled batches (repeat the last real entry so the batch width —
+and therefore the compiled program — never changes).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from multihop_offload_tpu.graphs.instance import (
+    PadSpec,
+    build_instance,
+    build_jobset,
+    compute_hop_matrix,
+    stack_instances,
+)
+from multihop_offload_tpu.serve.request import OffloadRequest
+
+
+class ShapeBuckets:
+    """Ascending ladder of pad shapes; assignment takes the smallest fit."""
+
+    def __init__(self, pads: Sequence[PadSpec]):
+        if not pads:
+            raise ValueError("at least one bucket PadSpec is required")
+        # ascending by padded volume proxy so "first fit" == "smallest fit"
+        self.pads: List[PadSpec] = sorted(pads, key=lambda p: (p.n, p.l, p.j, p.s))
+
+    @classmethod
+    def for_sizes(
+        cls, sizes: Sequence[tuple], num_buckets: int = 2, round_to: int = 8
+    ) -> "ShapeBuckets":
+        """Quantile-bucket expected case sizes by node count — the
+        `train.data.DatasetCache` rule, applied to a traffic profile instead
+        of a dataset: `sizes` is an iterable of (n, l, s, j) the operator
+        expects to serve (e.g. drawn from historical requests)."""
+        sizes = list(sizes)
+        n_buckets = max(1, min(num_buckets, len(sizes)))
+        order = np.argsort([s[0] for s in sizes], kind="stable")
+        groups = [g for g in np.array_split(order, n_buckets) if g.size]
+        return cls([
+            PadSpec.for_cases([sizes[i] for i in g], round_to=round_to)
+            for g in groups
+        ])
+
+    def __len__(self) -> int:
+        return len(self.pads)
+
+    def __getitem__(self, b: int) -> PadSpec:
+        return self.pads[b]
+
+    def bucket_for(self, n: int, l: int, s: int, j: int) -> Optional[int]:
+        """Smallest bucket that fits (n, l, s, j); None when none does
+        (the admission path rejects — an oversized graph must not recompile
+        the service)."""
+        for b, p in enumerate(self.pads):
+            if n <= p.n and l <= p.l and s <= p.s and j <= p.j:
+                return b
+        return None
+
+
+def pack_bucket(
+    reqs: Sequence[OffloadRequest],
+    pad: PadSpec,
+    slots: int,
+    dtype=np.float32,
+    hop_cache: Optional[Dict] = None,
+) -> Tuple:
+    """Pad + stack up to `slots` requests into one batched (Instance, JobSet).
+
+    Returns `(binst, bjobs)` with leading axis exactly `slots`: a partially
+    filled batch repeats its last real request (pad rows are never demuxed),
+    so every tick of a bucket presents the identical shape signature to jit.
+    Host-side numpy throughout — `stack_instances` ships one transfer per
+    leaf when the jitted program is called.
+    """
+    if not reqs or len(reqs) > slots:
+        raise ValueError(f"need 1..{slots} requests, got {len(reqs)}")
+    insts, jobsets = [], []
+    for r in reqs:
+        hop = None
+        if hop_cache is not None and r.topo_key is not None:
+            hop = hop_cache.get((r.topo_key, pad.n))
+        if hop is None:
+            hop = compute_hop_matrix(r.topo, pad.n)
+            if hop_cache is not None and r.topo_key is not None:
+                hop_cache[(r.topo_key, pad.n)] = hop
+        insts.append(build_instance(
+            r.topo, r.roles, r.proc_bws, r.link_rates, r.t_max, pad,
+            dtype=dtype, hop=hop, device=False,
+        ))
+        jobsets.append(build_jobset(
+            r.job_src, r.job_rate, pad_jobs=pad.j, ul=r.ul, dl=r.dl,
+            dtype=dtype, device=False,
+        ))
+    while len(insts) < slots:
+        insts.append(insts[-1])
+        jobsets.append(jobsets[-1])
+    return stack_instances(insts), stack_instances(jobsets)
+
+
+def padding_waste(reqs: Sequence[OffloadRequest], pad: PadSpec, slots: int) -> dict:
+    """Fraction of padded capacity carrying no real work this batch —
+    the price of the bucket quantization, per resource axis."""
+    real_jobs = sum(r.num_jobs for r in reqs)
+    real_nodes = sum(r.topo.n for r in reqs)
+    return {
+        "slot": 1.0 - len(reqs) / slots,
+        "jobs": 1.0 - real_jobs / (slots * pad.j),
+        "nodes": 1.0 - real_nodes / (slots * pad.n),
+    }

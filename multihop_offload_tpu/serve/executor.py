@@ -1,0 +1,120 @@
+"""Device-resident bucket executor: ONE fused jitted program per tick.
+
+The Evaluator's weakness (VERDICT.md: 3.9x vs the 10x target) is dispatch
+count — one eval program plus one metrics program per method per chunk.
+Here the whole decision pipeline for a batch of requests — actor forward,
+delay head, offloading decision, route trace, empirical scoring — is one
+`jax.vmap` of the SAME `agent.policy.forward_env` the drivers run, jitted
+once per bucket shape and invoked once per tick: decisions/dispatch scales
+with the slot count instead of being fixed by the method loop.
+
+Checkpoint hot-load: weights are program ARGUMENTS, not compile-time
+constants, so swapping in a freshly trained policy (`train.checkpoints`
+orbax tree) touches no compiled executable — the Podracer property
+(arXiv:2104.06272) of keeping the model device-resident across a stream of
+requests.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+
+from multihop_offload_tpu.agent.policy import forward_env
+from multihop_offload_tpu.env.policies import baseline_policy
+from multihop_offload_tpu.serve.bucketing import ShapeBuckets
+from multihop_offload_tpu.train import checkpoints as ckpt_lib
+
+
+class BucketExecutor:
+    """Compiled decision programs over a bucket ladder, plus weight state."""
+
+    def __init__(
+        self,
+        model,
+        variables,
+        buckets: ShapeBuckets,
+        apsp_impl: str = "xla",
+        fp_impl: str = "xla",
+        prob: bool = False,
+    ):
+        from multihop_offload_tpu.ops.fixed_point import resolve_fixed_point
+        from multihop_offload_tpu.ops.minplus import resolve_apsp
+
+        self.model = model
+        self.variables = variables
+        self.buckets = buckets
+        self.dispatch_count = 0
+        self.loaded_step: Optional[int] = None
+        self._steps = {}
+        for b, pad in enumerate(buckets.pads):
+            apsp_fn, _ = resolve_apsp(apsp_impl, pad.n)
+            fp_fn, _ = resolve_fixed_point(fp_impl, pad.l)
+
+            def gnn_step(variables, binst, bjobs, keys,
+                         _apsp=apsp_fn, _fp=fp_fn):
+                def one(inst, jb, k):
+                    outcome, _ = forward_env(
+                        model, variables, inst, jb, k, prob=prob,
+                        apsp_fn=_apsp, fp_fn=_fp,
+                    )
+                    d = outcome.decision
+                    return d.dst, d.is_local, d.delay_est, outcome.job_total
+
+                return jax.vmap(one)(binst, bjobs, keys)
+
+            def baseline_step(binst, bjobs, keys, _apsp=apsp_fn, _fp=fp_fn):
+                def one(inst, jb, k):
+                    o = baseline_policy(inst, jb, k, apsp_fn=_apsp, fp_fn=_fp)
+                    d = o.decision
+                    return d.dst, d.is_local, d.delay_est, o.job_total
+
+                return jax.vmap(one)(binst, bjobs, keys)
+
+            self._steps[b] = (jax.jit(gnn_step), jax.jit(baseline_step))
+
+    def run(self, bucket: int, binst, bjobs, keys, degraded: bool = False):
+        """One fused dispatch; returns host numpy (dst, is_local, delay_est,
+        job_total), each (slots, pad.j), via one bulk device->host fetch."""
+        gnn, baseline = self._steps[bucket]
+        out = (baseline(binst, bjobs, keys) if degraded
+               else gnn(self.variables, binst, bjobs, keys))
+        self.dispatch_count += 1
+        return tuple(np.asarray(x) for x in jax.device_get(out))
+
+    def hot_reload(self, model_dir: str, which: str = "orbax") -> Optional[int]:
+        """Swap in the latest checkpoint under `model_dir/{which}` if it is
+        newer than what is loaded.  Returns the step loaded, or None when
+        already current / no checkpoint exists.  Params must match the live
+        tree's shapes — a wrong-architecture checkpoint fails loudly here
+        rather than as a shape error mid-dispatch."""
+        directory = os.path.join(model_dir, which)
+        step = ckpt_lib.latest_step(directory)
+        if step is None or step == self.loaded_step:
+            return None
+        restored = ckpt_lib.restore_checkpoint_raw(directory, step)
+        cur = self.variables["params"]
+
+        def _shapes(tree):
+            flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+            return [(jax.tree_util.keystr(p), np.shape(x)) for p, x in flat]
+
+        if _shapes(restored["params"]) != _shapes(cur):
+            raise ValueError(
+                f"checkpoint {directory} step {step} params do not match the "
+                "serving model architecture"
+            )
+        # rebuild in the live tree's container types, cast to live dtypes
+        leaves = jax.tree_util.tree_leaves(restored["params"])
+        rebuilt = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(cur), leaves
+        )
+        params = jax.tree_util.tree_map(
+            lambda t, r: np.asarray(r, dtype=np.asarray(t).dtype), cur, rebuilt
+        )
+        self.variables = {"params": params}
+        self.loaded_step = step
+        return step

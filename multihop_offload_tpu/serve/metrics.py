@@ -1,0 +1,115 @@
+"""Serving metrics surface.
+
+Counters and samples accumulated by `serve.service.OffloadService`, reduced
+to the operator dashboard numbers (decisions/sec, p50/p99 latency, per-bucket
+occupancy, padding waste, dispatches/request) and exported through the
+existing plumbing: `train.metrics.summarize_latencies` for the quantile math
+and `train.tb_logging.ScalarLogger` for TensorBoard.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List
+
+from multihop_offload_tpu.train.metrics import summarize_latencies
+from multihop_offload_tpu.train.tb_logging import ScalarLogger
+
+
+@dataclasses.dataclass
+class _BucketStats:
+    dispatches: int = 0
+    degraded_dispatches: int = 0
+    served: int = 0
+    occupancy_sum: float = 0.0     # real requests / slots, summed per dispatch
+    waste_jobs_sum: float = 0.0    # job-slot padding waste, summed per dispatch
+    waste_nodes_sum: float = 0.0
+
+
+@dataclasses.dataclass
+class ServingStats:
+    """Lifetime counters of one service; all host-side scalars."""
+
+    submitted: int = 0
+    admitted: int = 0
+    rejected: int = 0        # bounded-queue backpressure refusals
+    too_large: int = 0       # no bucket fits — permanent refusal
+    served: int = 0          # responses demuxed
+    degraded: int = 0        # responses served by the analytic baseline
+    decisions: int = 0       # real (unpadded) job decisions returned
+    ticks: int = 0
+    latencies_s: List[float] = dataclasses.field(default_factory=list)
+    buckets: Dict[int, _BucketStats] = dataclasses.field(default_factory=dict)
+
+    def bucket(self, b: int) -> _BucketStats:
+        return self.buckets.setdefault(b, _BucketStats())
+
+    def record_dispatch(self, b: int, n_real: int, slots: int, waste: dict,
+                        degraded: bool) -> None:
+        s = self.bucket(b)
+        s.dispatches += 1
+        s.degraded_dispatches += int(degraded)
+        s.served += n_real
+        s.occupancy_sum += n_real / slots
+        s.waste_jobs_sum += waste["jobs"]
+        s.waste_nodes_sum += waste["nodes"]
+
+    @property
+    def dispatches(self) -> int:
+        return sum(s.dispatches for s in self.buckets.values())
+
+    def summary(self, wall_s: float = 0.0) -> dict:
+        """The serving record — the schema `benchmarks/serving.json` commits."""
+        lat = summarize_latencies(self.latencies_s)
+        per_bucket = {}
+        for b, s in sorted(self.buckets.items()):
+            d = max(s.dispatches, 1)
+            per_bucket[str(b)] = {
+                "dispatches": s.dispatches,
+                "degraded_dispatches": s.degraded_dispatches,
+                "served": s.served,
+                "mean_occupancy": round(s.occupancy_sum / d, 4),
+                "mean_pad_waste_jobs": round(s.waste_jobs_sum / d, 4),
+                "mean_pad_waste_nodes": round(s.waste_nodes_sum / d, 4),
+            }
+        served = max(self.served, 1)
+        out = {
+            "submitted": self.submitted,
+            "admitted": self.admitted,
+            "rejected_backpressure": self.rejected,
+            "rejected_too_large": self.too_large,
+            "served": self.served,
+            "degraded": self.degraded,
+            "decisions": self.decisions,
+            "ticks": self.ticks,
+            "dispatches": self.dispatches,
+            "dispatches_per_request": round(self.dispatches / served, 4),
+            "dispatches_per_1k_requests": round(1000.0 * self.dispatches / served, 2),
+            "latency": lat,
+            "per_bucket": per_bucket,
+        }
+        if wall_s > 0:
+            out["wall_s"] = round(wall_s, 3)
+            out["requests_per_sec"] = round(self.served / wall_s, 2)
+            out["decisions_per_sec"] = round(self.decisions / wall_s, 2)
+        return out
+
+    def log_tb(self, tb: ScalarLogger, step: int, queue_depth: int = 0) -> None:
+        """Scalar snapshot onto a TensorBoard event file (no-op when the
+        logger is inactive)."""
+        if not tb.active:
+            return
+        lat = summarize_latencies(self.latencies_s)
+        tb.log_scalar("serve/queue_depth", queue_depth, step)
+        tb.log_scalar("serve/served", self.served, step)
+        tb.log_scalar("serve/degraded", self.degraded, step)
+        tb.log_scalar("serve/dispatches", self.dispatches, step)
+        if lat["count"]:
+            tb.log_scalar("serve/latency_p50_ms", lat["p50_ms"], step)
+            tb.log_scalar("serve/latency_p99_ms", lat["p99_ms"], step)
+        for b, s in self.buckets.items():
+            if s.dispatches:
+                tb.log_scalar(
+                    f"serve/bucket{b}_occupancy",
+                    s.occupancy_sum / s.dispatches, step,
+                )

@@ -1,0 +1,185 @@
+"""The offloading-decision service: admission -> batch -> dispatch -> demux.
+
+Continuous shape-bucketed batching: requests land in per-bucket FIFO queues
+under one global bound (backpressure — `submit` refuses instead of growing
+without limit); every `tick` drains up to `slots` requests per bucket, packs
+them into the bucket's static layout, runs ONE fused device program, and
+demultiplexes per-request responses.  When a tick finds its oldest pending
+request older than the deadline budget, the service is behind; that batch
+degrades to the analytic greedy baseline (`env.baseline` unit delays —
+no GNN forward), which trades decision quality for catch-up throughput and
+keeps latency bounded.  Degradation is per-batch, never per-slot: a tick is
+always exactly one program.
+
+PRNG: each request's decision key is `fold_in(PRNGKey(seed), request_id)` —
+structural, like the Evaluator's per-file keys, so any batching order of the
+same requests realizes identical decisions (the bit-parity property
+`tests/test_serve.py` pins).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Callable, Deque, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from multihop_offload_tpu.serve.bucketing import (
+    ShapeBuckets,
+    pack_bucket,
+    padding_waste,
+)
+from multihop_offload_tpu.serve.executor import BucketExecutor
+from multihop_offload_tpu.serve.metrics import ServingStats
+from multihop_offload_tpu.serve.request import OffloadRequest, OffloadResponse
+
+
+class OffloadService:
+    """Single-host serving loop over a `BucketExecutor`.
+
+    `clock` is injectable (tests drive deterministic time); everything else
+    is host-side bookkeeping around the one-dispatch-per-bucket tick.
+    """
+
+    def __init__(
+        self,
+        model,
+        variables,
+        buckets: ShapeBuckets,
+        slots: int = 8,
+        queue_cap: int = 64,
+        deadline_s: float = 0.5,
+        seed: int = 0,
+        prob: bool = False,
+        apsp_impl: str = "xla",
+        fp_impl: str = "xla",
+        dtype=np.float32,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if slots < 1 or queue_cap < 1:
+            raise ValueError("slots and queue_cap must be >= 1")
+        self.executor = BucketExecutor(
+            model, variables, buckets,
+            apsp_impl=apsp_impl, fp_impl=fp_impl, prob=prob,
+        )
+        self.buckets = buckets
+        self.slots = slots
+        self.queue_cap = queue_cap
+        self.deadline_s = deadline_s
+        self.dtype = dtype
+        self.clock = clock
+        self.stats = ServingStats()
+        self._queues: List[Deque[Tuple[OffloadRequest, float]]] = [
+            deque() for _ in buckets.pads
+        ]
+        self._base_key = jax.random.PRNGKey(seed)
+        self._hop_cache: dict = {}
+
+    # ---- admission ---------------------------------------------------------
+
+    @property
+    def queue_depth(self) -> int:
+        return sum(len(q) for q in self._queues)
+
+    def submit(self, req: OffloadRequest, now: Optional[float] = None) -> bool:
+        """Admit a request, or refuse it (False) under backpressure / when no
+        bucket fits.  Refusal is the client's signal to retry elsewhere —
+        a bounded queue keeps the p99 of everything already admitted."""
+        self.stats.submitted += 1
+        b = self.buckets.bucket_for(*req.sizes)
+        if b is None:
+            self.stats.too_large += 1
+            return False
+        if self.queue_depth >= self.queue_cap:
+            self.stats.rejected += 1
+            return False
+        self._queues[b].append((req, self.clock() if now is None else now))
+        self.stats.admitted += 1
+        return True
+
+    # ---- the serving tick --------------------------------------------------
+
+    def request_key(self, request_id: int):
+        return jax.random.fold_in(self._base_key, np.uint32(request_id))
+
+    def tick(self, now: Optional[float] = None) -> List[OffloadResponse]:
+        """Serve one batch per non-empty bucket; returns demuxed responses."""
+        self.stats.ticks += 1
+        responses: List[OffloadResponse] = []
+        for b, q in enumerate(self._queues):
+            if not q:
+                continue
+            t_now = self.clock() if now is None else now
+            degraded = (t_now - q[0][1]) > self.deadline_s
+            taken = [q.popleft() for _ in range(min(self.slots, len(q)))]
+            reqs = [r for r, _ in taken]
+            pad = self.buckets[b]
+            binst, bjobs = pack_bucket(
+                reqs, pad, self.slots, dtype=self.dtype,
+                hop_cache=self._hop_cache,
+            )
+            keys = [self.request_key(r.request_id) for r in reqs]
+            while len(keys) < self.slots:   # pad slots reuse the last key
+                keys.append(keys[-1])
+            out = self.executor.run(
+                b, binst, bjobs, np.stack([np.asarray(k) for k in keys]),
+                degraded=degraded,
+            )
+            t_done = self.clock() if now is None else now
+            responses.extend(demux_responses(
+                taken, out, "baseline" if degraded else "gnn", b, t_done
+            ))
+            waste = padding_waste(reqs, pad, self.slots)
+            self.stats.record_dispatch(b, len(reqs), self.slots, waste, degraded)
+            self.stats.served += len(reqs)
+            self.stats.degraded += len(reqs) if degraded else 0
+            self.stats.decisions += sum(r.num_jobs for r in reqs)
+            self.stats.latencies_s.extend(
+                max(t_done - t_enq, 0.0) for _, t_enq in taken
+            )
+        return responses
+
+    def drain(self, max_ticks: int = 1000) -> List[OffloadResponse]:
+        """Tick until every admitted request is answered (bounded)."""
+        responses: List[OffloadResponse] = []
+        for _ in range(max_ticks):
+            if self.queue_depth == 0:
+                break
+            responses.extend(self.tick())
+        return responses
+
+    # ---- weight management -------------------------------------------------
+
+    def hot_reload(self, model_dir: str, which: str = "orbax") -> Optional[int]:
+        """Poll the orbax tree and swap in a newer policy without restarting
+        (compiled programs take weights as arguments — no retrace)."""
+        return self.executor.hot_reload(model_dir, which=which)
+
+
+def demux_responses(
+    taken: List[Tuple[OffloadRequest, float]],
+    out: Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray],
+    served_by: str,
+    bucket: int,
+    t_done: float,
+) -> List[OffloadResponse]:
+    """The response demultiplexer: slice each real slot's padded decision
+    arrays down to the request's true job count.  Pad slots (batch filler)
+    and pad job entries are dropped here and never reach a client."""
+    dst, is_local, delay_est, job_total = out
+    responses = []
+    for i, (req, t_enq) in enumerate(taken):
+        nj = req.num_jobs
+        responses.append(OffloadResponse(
+            request_id=req.request_id,
+            dst=dst[i, :nj].copy(),
+            is_local=is_local[i, :nj].copy(),
+            delay_est=delay_est[i, :nj].copy(),
+            job_total=job_total[i, :nj].copy(),
+            served_by=served_by,
+            bucket=bucket,
+            latency_s=max(t_done - t_enq, 0.0),
+        ))
+    return responses

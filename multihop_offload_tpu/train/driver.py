@@ -744,6 +744,16 @@ class Evaluator(_Harness):
             # clamp — an oversized shard spec must not IndexError mid-sweep
             fids = ([f for f in file_ids if 0 <= f < n_files]
                     if file_ids is not None else list(range(n_files)))
+            if file_ids is not None and not fids:
+                # every requested id fell outside [0, n_files): a misaligned
+                # shard spec (scripts/multiprocess_eval.py) must fail loudly
+                # HERE, not as a missing-CSV read in whatever merges the
+                # shards later
+                raise ValueError(
+                    f"file_ids selects no files: every id is outside "
+                    f"[0, {n_files}) — check the shard spec against the "
+                    f"dataset size/files_limit"
+                )
             eval_csv = _CsvFlusher(csv_path, TEST_COLUMNS, enabled=write_csv)
             rows = []
             # one-file host/device pipeline (`_Prefetcher`, cfg.prefetch):

@@ -25,6 +25,26 @@ def _masked_mean(x, mask):
     return jnp.sum(jnp.where(mask, x, 0.0)) / denom
 
 
+def summarize_latencies(samples_s) -> dict:
+    """Host-side latency summary (seconds in, milliseconds out): count,
+    mean, p50, p99, max.  Shared by the serving metrics surface
+    (`serve.metrics`) and any driver that wants wall-time quantiles; numpy
+    because these are O(requests) host scalars, not device work."""
+    import numpy as np
+
+    x = np.asarray(list(samples_s), dtype=np.float64)
+    if x.size == 0:
+        return {"count": 0, "mean_ms": None, "p50_ms": None, "p99_ms": None,
+                "max_ms": None}
+    return {
+        "count": int(x.size),
+        "mean_ms": float(x.mean() * 1e3),
+        "p50_ms": float(np.percentile(x, 50) * 1e3),
+        "p99_ms": float(np.percentile(x, 99) * 1e3),
+        "max_ms": float(x.max() * 1e3),
+    }
+
+
 def instance_metrics(
     job_total: jnp.ndarray,
     baseline_total: jnp.ndarray,

@@ -1,0 +1,176 @@
+"""Closed-loop load generator for the serving subsystem — the committed
+throughput/latency record.
+
+Drives `serve.OffloadService` with a synthetic traffic pool at full
+admission pressure (the queue is kept at capacity; every tick drains full
+batches), measures decisions/sec, p50/p99 latency, per-bucket occupancy and
+padding waste, and dispatches per request — the number the subsystem exists
+to attack.  Two legs share one compiled service:
+
+  * `gnn` — the policy path (deadline set high so nothing degrades);
+  * `degraded` — deadline 0 forces every batch onto the analytic greedy
+    baseline, recording the graceful-degradation catch-up throughput.
+
+The Evaluator comparison is structural: its per-chunk path issues 1 eval
+program + 3 `_metrics_batch` programs per 10-instance chunk = 0.4
+dispatches/request (`train/driver.py` `_eval_methods` + `_method_metrics`);
+the service must sit strictly below.
+
+Writes `benchmarks/serving.json`.  Runs on CPU by default (pinned via
+jax.config per docs/OPERATIONS.md — the env var is captured before user
+code runs); pass --platform=tpu for a chip leg, bounded, idle host.
+
+Usage: python scripts/serve_loadgen.py [--requests 1000] [--slots 16]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+OUT = os.path.join(REPO, "benchmarks", "serving.json")
+
+# the Evaluator's per-chunk dispatch structure (train/driver.py:763-779):
+# one fused eval program + one _metrics_batch program per method per
+# num_instances-chunk, at the production num_instances=10
+EVALUATOR_DISPATCHES_PER_REQUEST = (1 + 3) / 10
+
+
+def run_leg(service, pool, requests, seed, arrival_scale, deadline_s):
+    """One closed-loop leg over a warm service; returns its summary dict."""
+    from multihop_offload_tpu.serve.metrics import ServingStats
+    from multihop_offload_tpu.serve.workload import request_stream
+
+    service.deadline_s = deadline_s
+    service.stats = ServingStats()
+    service.executor.dispatch_count = 0
+    pending = list(request_stream(
+        pool, requests, seed=seed, arrival_scale=arrival_scale
+    ))
+    pending.reverse()
+    t0 = time.monotonic()
+    while pending or service.queue_depth:
+        while pending:
+            req = pending.pop()
+            if not service.submit(req):
+                pending.append(req)
+                break
+        service.tick()
+    wall = time.monotonic() - t0
+    return service.stats.summary(wall_s=wall)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=1000)
+    ap.add_argument("--slots", type=int, default=16)
+    ap.add_argument("--queue-cap", type=int, default=128)
+    ap.add_argument("--deadline-ms", type=float, default=30000.0,
+                    help="gnn-leg degradation budget (high: measure the policy path)")
+    ap.add_argument("--sizes", type=str, default="16,24")
+    ap.add_argument("--buckets", type=int, default=2)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--arrival-scale", type=float, default=0.15)
+    ap.add_argument("--platform", type=str, default="cpu")
+    ap.add_argument("--out", type=str, default=OUT)
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    from multihop_offload_tpu.cli.serve import build_service
+    from multihop_offload_tpu.config import Config
+    from multihop_offload_tpu.serve.workload import case_pool, request_stream
+
+    cfg = Config(
+        serve_slots=args.slots, serve_queue_cap=args.queue_cap,
+        serve_buckets=args.buckets, serve_sizes=args.sizes,
+        seed=args.seed, dtype="float32",
+        model_root=os.path.join(REPO, "model"),
+    )
+    sizes = [int(s) for s in args.sizes.split(",")]
+    pool = case_pool(sizes, per_size=2, seed=args.seed)
+    service, _ = build_service(cfg, pool=pool)
+
+    # warm-up: compile every (bucket, path) program outside the timed legs
+    for warm_req in request_stream(pool, len(pool), seed=args.seed + 99,
+                                   arrival_scale=args.arrival_scale,
+                                   id_offset=10**9):
+        service.submit(warm_req)
+    while service.queue_depth:
+        service.tick()
+    from multihop_offload_tpu.serve.bucketing import pack_bucket
+    import numpy as np
+
+    for b, pad in enumerate(service.buckets.pads):
+        for warm_req in request_stream(pool, len(pool), seed=args.seed + 98,
+                                       arrival_scale=args.arrival_scale,
+                                       id_offset=2 * 10**9):
+            if service.buckets.bucket_for(*warm_req.sizes) == b:
+                binst, bjobs = pack_bucket([warm_req], pad, service.slots,
+                                           dtype=service.dtype,
+                                           hop_cache=service._hop_cache)
+                key = np.stack([np.asarray(service.request_key(0))] * service.slots)
+                service.executor.run(b, binst, bjobs, key, degraded=True)
+                break
+
+    legs = {
+        "gnn": run_leg(service, pool, args.requests, args.seed + 1,
+                       args.arrival_scale, args.deadline_ms / 1e3),
+        "degraded": run_leg(service, pool, args.requests, args.seed + 2,
+                            args.arrival_scale, 0.0),
+    }
+    assert legs["gnn"]["degraded"] == 0, "gnn leg unexpectedly degraded"
+    assert legs["degraded"]["degraded"] == legs["degraded"]["served"]
+
+    dpr = legs["gnn"]["dispatches_per_request"]
+    record = {
+        "metric": "offload_decision_serving",
+        "platform": args.platform,
+        "config": {
+            "requests_per_leg": args.requests,
+            "slots": args.slots,
+            "queue_cap": args.queue_cap,
+            "sizes": sizes,
+            "buckets": [
+                {"n": p.n, "l": p.l, "s": p.s, "j": p.j}
+                for p in service.buckets.pads
+            ],
+            "seed": args.seed,
+            "arrival_scale": args.arrival_scale,
+            "checkpoint_step": service.executor.loaded_step,
+        },
+        "legs": legs,
+        "dispatch_comparison": {
+            "serving_dispatches_per_request": dpr,
+            "evaluator_dispatches_per_request": EVALUATOR_DISPATCHES_PER_REQUEST,
+            "reduction_factor": round(EVALUATOR_DISPATCHES_PER_REQUEST / dpr, 2),
+            "below_evaluator": dpr < EVALUATOR_DISPATCHES_PER_REQUEST,
+            "note": "evaluator structure: 1 eval + 3 metrics programs per "
+                    "10-instance chunk (train/driver.py); serving: 1 fused "
+                    "program per tick per bucket over serve_slots requests",
+        },
+        "scope": "closed-loop synthetic traffic, warm service, host-side "
+                 "queueing included in latency",
+    }
+    assert record["dispatch_comparison"]["below_evaluator"], (
+        f"serving dispatches/request {dpr} not below the Evaluator's "
+        f"{EVALUATOR_DISPATCHES_PER_REQUEST}"
+    )
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=2)
+        f.write("\n")
+    print(json.dumps(record, indent=2))
+    print(f"\nwritten to {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -339,3 +339,18 @@ def test_file_ids_shard_matches_sequential(tmp_path, tiny_dataset, monkeypatch):
         seq.sort_values(key)[cols].reset_index(drop=True),
         merged.sort_values(key)[cols].reset_index(drop=True),
     )
+
+
+def test_empty_file_ids_raises(tmp_path, tiny_dataset, monkeypatch):
+    """A shard spec that selects zero files is a misconfiguration (wrong
+    process count / dataset size) and must fail at the Evaluator, not as a
+    missing-CSV error in whatever merges the shards downstream."""
+    monkeypatch.chdir(tmp_path)
+    cfg = _cfg(tmp_path, tiny_dataset, mesh_data=1)
+    ev = Evaluator(cfg)
+    n = len(ev.data)
+    with pytest.raises(ValueError, match="file_ids selects no files"):
+        ev.run(file_ids=range(n, n + 4), verbose=False)
+    # a generator that filters empty is caught too (not just empty lists)
+    with pytest.raises(ValueError, match="file_ids selects no files"):
+        ev.run(file_ids=(f for f in [-1, n]), verbose=False)

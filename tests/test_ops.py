@@ -320,12 +320,16 @@ def test_resolve_fixed_point_paths():
 
     fn, path = resolve_fixed_point("xla", 256)
     assert fn is None and path == "xla"
-    # beyond the measured ladder top (512): direct XLA
+    # beyond the in-step-measured win (L=256): direct XLA — L=384/512 have
+    # no in-step A/B and the 384 microbench rung loses, so 'auto' stops at
+    # the evidence; fp_impl='pallas' is the explicit override there
     fn, path = resolve_fixed_point("auto", 640)
     assert fn is None and path == "xla"
-    # L=512 is inside the round-5 measured win; off-TPU it still resolves
-    # to the honest fallback path
     fn, path = resolve_fixed_point("auto", 512)
+    assert fn is None and path == "xla"
+    # L=256 is the measured 1.16x in-step win; off-TPU it still resolves
+    # to the honest fallback path
+    fn, path = resolve_fixed_point("auto", 256)
     assert fn is None and path == "xla-fallback"
     # inside the measured win but suite runs on CPU: direct XLA, honest path
     fn, path = resolve_fixed_point("auto", 200)

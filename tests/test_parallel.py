@@ -4,7 +4,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 import pytest
-from jax import shard_map
+from multihop_offload_tpu.parallel.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from multihop_offload_tpu.agent import make_optimizer, replay_init

@@ -1,0 +1,260 @@
+"""serve/: batched decision service — parity, backpressure, degradation.
+
+The load-bearing property is bit-parity: demux(route(batch(requests)))
+must realize the SAME decisions as running each request alone through
+`agent.policy.forward_env` at the same pad shape with the same structural
+key.  Batching is then purely a throughput transform — it can never change
+what the service answers.
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from multihop_offload_tpu.config import Config
+from multihop_offload_tpu.graphs.instance import (
+    build_instance,
+    build_jobset,
+    compute_hop_matrix,
+)
+from multihop_offload_tpu.serve.bucketing import pack_bucket
+from multihop_offload_tpu.serve.workload import (
+    buckets_for_pool,
+    case_pool,
+    request_stream,
+)
+
+SIZES = [10, 16]
+
+
+def _make_service(slots=3, queue_cap=16, deadline_s=60.0, clock=None, **cfg_kw):
+    """Small 2-bucket service on synthetic traffic; fresh-init weights."""
+    from multihop_offload_tpu.cli.serve import build_service
+    from multihop_offload_tpu.serve.service import OffloadService
+
+    cfg = Config(seed=7, dtype="float32", serve_slots=slots,
+                 serve_queue_cap=queue_cap, serve_deadline_s=deadline_s,
+                 serve_buckets=2, model_root="/nonexistent-model-root",
+                 **cfg_kw)
+    pool = case_pool(SIZES, per_size=1, seed=cfg.seed)
+    service, pool = build_service(cfg, pool=pool)
+    if clock is not None:
+        # injectable time: rebuild with the deterministic clock, same programs
+        service = OffloadService(
+            service.executor.model, service.executor.variables,
+            service.buckets, slots=slots, queue_cap=queue_cap,
+            deadline_s=deadline_s, seed=cfg.seed, clock=clock,
+        )
+    return service, pool
+
+
+@pytest.fixture(scope="module")
+def served():
+    """One shared service + a drained mixed-bucket stream: 5 requests land
+    round-robin as 3+2 across the 2 buckets, so slots=2 leaves a
+    partially-filled final batch in bucket 0 and needs exactly 2 ticks."""
+    service, pool = _make_service(slots=2)
+    reqs = list(request_stream(pool, 5, seed=11))
+    for r in reqs:
+        assert service.submit(r)
+    responses = service.drain()
+    return service, reqs, responses
+
+
+def test_smoke_two_ticks(served):
+    service, reqs, responses = served
+    # tick 1 serves 2+2 (one program per bucket), tick 2 the leftover 1
+    assert service.stats.ticks == 2
+    assert service.executor.dispatch_count == 3
+    assert sorted(r.request_id for r in responses) == sorted(
+        r.request_id for r in reqs
+    )
+    by_id = {r.request_id: r for r in responses}
+    for req in reqs:
+        resp = by_id[req.request_id]
+        assert resp.served_by == "gnn"
+        assert resp.dst.shape == (req.num_jobs,)
+        assert resp.is_local.shape == (req.num_jobs,)
+        # every chosen node exists in THIS request's graph (pad rows never
+        # leak out of the demux)
+        assert (resp.dst >= 0).all() and (resp.dst < req.topo.n).all()
+        assert np.isfinite(resp.delay_est).all()
+        assert resp.latency_s >= 0.0
+    # dispatch amortization: strictly fewer programs than requests
+    assert service.executor.dispatch_count < len(reqs)
+    s = service.stats.summary(wall_s=1.0)
+    assert s["served"] == len(reqs) and s["degraded"] == 0
+    assert s["dispatches_per_request"] < 1.0
+
+
+def test_batched_decisions_bit_identical_to_single_instance(served):
+    """The ISSUE's property test: mixed buckets + partially-filled final
+    batch, each demuxed decision bit-identical to the single-instance
+    `forward_env` at the same pad shape and structural key."""
+    from multihop_offload_tpu.agent.policy import forward_env
+
+    service, reqs, responses = served
+    model = service.executor.model
+    variables = service.executor.variables
+    by_id = {r.request_id: r for r in responses}
+    buckets_seen = set()
+    for req in reqs:
+        b = service.buckets.bucket_for(*req.sizes)
+        buckets_seen.add(b)
+        pad = service.buckets[b]
+        inst = build_instance(
+            req.topo, req.roles, req.proc_bws, req.link_rates, req.t_max,
+            pad, dtype=service.dtype,
+            hop=compute_hop_matrix(req.topo, pad.n),
+        )
+        jobs = build_jobset(
+            req.job_src, req.job_rate, pad_jobs=pad.j, ul=req.ul, dl=req.dl,
+            dtype=service.dtype,
+        )
+        outcome, _ = forward_env(
+            model, variables, inst, jobs, service.request_key(req.request_id)
+        )
+        nj = req.num_jobs
+        resp = by_id[req.request_id]
+        np.testing.assert_array_equal(
+            resp.dst, np.asarray(outcome.decision.dst)[:nj]
+        )
+        np.testing.assert_array_equal(
+            resp.is_local, np.asarray(outcome.decision.is_local)[:nj]
+        )
+        np.testing.assert_allclose(
+            resp.delay_est, np.asarray(outcome.decision.delay_est)[:nj],
+            rtol=1e-5, atol=1e-6,
+        )
+        np.testing.assert_allclose(
+            resp.job_total, np.asarray(outcome.job_total)[:nj],
+            rtol=1e-5, atol=1e-6,
+        )
+    assert len(buckets_seen) == 2, "stream did not exercise both buckets"
+
+
+def test_pack_bucket_pads_by_repeating_last(served):
+    service, reqs, _ = served
+    b = service.buckets.bucket_for(*reqs[0].sizes)
+    pad = service.buckets[b]
+    binst, bjobs = pack_bucket([reqs[0]], pad, 3, dtype=service.dtype)
+    # filler slots repeat the last real entry: identical leaves, static width
+    leaf = jax.tree_util.tree_leaves(binst)[0]
+    assert np.asarray(leaf).shape[0] == 3
+    for arr in jax.tree_util.tree_leaves(binst):
+        a = np.asarray(arr)
+        np.testing.assert_array_equal(a[1], a[0])
+        np.testing.assert_array_equal(a[2], a[0])
+
+
+def test_backpressure_bounded_queue():
+    service, pool = _make_service(slots=2, queue_cap=3)
+    reqs = list(request_stream(pool, 6, seed=21))
+    admitted = [service.submit(r) for r in reqs[:3]]
+    assert all(admitted)
+    assert not service.submit(reqs[3]), "submit beyond queue_cap must refuse"
+    assert service.stats.rejected == 1
+    service.tick()  # frees capacity
+    assert service.submit(reqs[3])
+    # an over-sized graph is refused as too_large, never queued
+    big = next(iter(request_stream(case_pool([40], per_size=1, seed=5), 1)))
+    assert service.buckets.bucket_for(*big.sizes) is None
+    assert not service.submit(big)
+    assert service.stats.too_large == 1
+
+
+def test_deadline_degrades_to_baseline():
+    """A tick past the deadline budget serves its batch with the analytic
+    greedy baseline — same decisions as `env.policies.baseline_policy` run
+    alone, flagged `served_by='baseline'`."""
+    from multihop_offload_tpu.env.policies import baseline_policy
+
+    t = [100.0]
+    service, pool = _make_service(slots=2, deadline_s=0.5, clock=lambda: t[0])
+    reqs = list(request_stream(pool, 2, seed=31))
+    for r in reqs:
+        service.submit(r)
+    t[0] += 10.0  # the service fell behind: oldest wait >> deadline
+    responses = service.drain()
+    assert len(responses) == len(reqs)
+    assert all(r.served_by == "baseline" for r in responses)
+    assert service.stats.degraded == len(reqs)
+    by_id = {r.request_id: r for r in responses}
+    for req in reqs:
+        b = service.buckets.bucket_for(*req.sizes)
+        pad = service.buckets[b]
+        inst = build_instance(
+            req.topo, req.roles, req.proc_bws, req.link_rates, req.t_max,
+            pad, dtype=service.dtype,
+            hop=compute_hop_matrix(req.topo, pad.n),
+        )
+        jobs = build_jobset(
+            req.job_src, req.job_rate, pad_jobs=pad.j, ul=req.ul, dl=req.dl,
+            dtype=service.dtype,
+        )
+        o = baseline_policy(inst, jobs, service.request_key(req.request_id))
+        nj = req.num_jobs
+        np.testing.assert_array_equal(
+            by_id[req.request_id].dst, np.asarray(o.decision.dst)[:nj]
+        )
+
+
+def test_hot_reload_swaps_weights_without_retrace():
+    from multihop_offload_tpu.train import checkpoints as ckpt_lib
+
+    service, pool = _make_service(slots=2)
+    req = next(iter(request_stream(pool, 1, seed=41)))
+    service.submit(req)
+    r0 = service.drain()[0]
+    programs_before = service.executor._steps  # the compiled-step table
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        bumped = jax.tree_util.tree_map(
+            lambda x: np.asarray(x) + 0.25, service.executor.variables["params"]
+        )
+        ckpt_lib.save_checkpoint(os.path.join(d, "orbax"), 5, {"params": bumped})
+        assert service.hot_reload(d) == 5
+        assert service.executor.loaded_step == 5
+        assert service.hot_reload(d) is None  # already current
+        np.testing.assert_allclose(
+            np.asarray(jax.tree_util.tree_leaves(
+                service.executor.variables["params"])[0]),
+            np.asarray(jax.tree_util.tree_leaves(bumped)[0]),
+        )
+        # same compiled programs, new weights, (generically) new decisions
+        assert service.executor._steps is programs_before
+        service.submit(req)
+        r1 = service.drain()[0]
+        assert r1.dst.shape == r0.dst.shape
+        # a wrong-architecture checkpoint must fail loudly at reload time
+        wrong = {"params": {"oops": np.zeros((2, 2), np.float32)}}
+        ckpt_lib.save_checkpoint(os.path.join(d, "orbax"), 6, wrong)
+        with pytest.raises(ValueError, match="do not match"):
+            service.hot_reload(d)
+
+
+@pytest.mark.slow
+def test_loadgen_soak(tmp_path):
+    """The committed-record path end to end at reduced scale: both legs,
+    internal dispatch/degradation asserts, and the serving.json schema."""
+    import json
+    import subprocess
+    import sys
+
+    out = tmp_path / "serving.json"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "serve_loadgen.py"),
+         "--requests", "60", "--slots", "4", "--queue-cap", "16",
+         "--out", str(out)],
+        capture_output=True, text=True, timeout=900, cwd=repo,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(out.read_text())
+    assert rec["dispatch_comparison"]["below_evaluator"] is True
+    assert rec["legs"]["gnn"]["served"] == 60
+    assert rec["legs"]["degraded"]["degraded"] == 60
