@@ -147,6 +147,15 @@ def build_bench_batch():
     rng = np.random.default_rng(0)
     recs = _load_cases(num_networks, rng)
     pad = PadSpec.for_cases([r.sizes for r in recs], round_to=8)
+    # BENCH_PAD_L floors the link-pad: the same real workload computed at a
+    # larger padded L.  This is the fp_impl A/B rung switch
+    # (scripts/fp_ab.py runs L=256/384/512 to place _AUTO_FP_MAX_L); only
+    # raising is allowed — real links must still fit
+    pad_l = int(os.environ.get("BENCH_PAD_L", 0))
+    if pad_l > pad.l:
+        import dataclasses as _dc
+
+        pad = _dc.replace(pad, l=pad_l)
 
     insts, jobsets = [], []
     for rec in recs:
@@ -190,8 +199,25 @@ def measure():
 
     from multihop_offload_tpu.agent import forward_backward
 
+    # BENCH_OBS_LOG=<path> emits the obs run log (manifest + bench phase
+    # events + retrace counters) alongside the JSON line on stdout; render
+    # with `mho-obs <path>` — the env knob mirrors the drivers' cfg.obs_log
+    import types
+
+    from multihop_offload_tpu import obs
+    from multihop_offload_tpu.obs.spans import span
+
+    runlog = obs.start_run(types.SimpleNamespace(
+        obs_log=os.environ.get("BENCH_OBS_LOG", ""),
+        obs_prom=os.environ.get("BENCH_OBS_PROM", ""),
+    ), role="bench")
+
     platform = jax.default_backend()
-    model, variables, binst, bjobs, pad, batch = build_bench_batch()
+    t_build = time.time()
+    with span("bench/build"):
+        model, variables, binst, bjobs, pad, batch = build_bench_batch()
+    if runlog is not None:
+        runlog.phase("bench/build", time.time() - t_build)
 
     # kernel knobs, resolved exactly as the drivers do (None = XLA); the
     # env overrides are the on-chip A/B switch for the Pallas kernels
@@ -229,8 +255,10 @@ def measure():
     # fields (VERDICT r3 item 2).
     run = step
     flops_per_step = bytes_per_step = None
+    t_compile = time.time()
     try:
-        compiled = step.lower(variables, binst, bjobs, keys).compile()
+        with span("bench/compile"):
+            compiled = step.lower(variables, binst, bjobs, keys).compile()
         run = compiled
         ca = compiled.cost_analysis()
         if isinstance(ca, (list, tuple)):
@@ -240,10 +268,19 @@ def measure():
             bytes_per_step = float(ca.get("bytes accessed", 0.0)) or None
     except Exception as exc:  # cost analysis is diagnostic, never fatal
         print(f"warning: AOT cost_analysis unavailable: {exc}", file=sys.stderr)
+    if runlog is not None:
+        runlog.phase("bench/compile", time.time() - t_compile)
 
     # warmup (compile here only if the AOT path failed)
-    out = run(variables, binst, bjobs, keys)
-    jax.block_until_ready(out)
+    t_warm = time.time()
+    with span("bench/warmup"):
+        out = run(variables, binst, bjobs, keys)
+        jax.block_until_ready(out)
+    if runlog is not None:
+        runlog.phase("bench/warmup", time.time() - t_warm)
+        from multihop_offload_tpu.obs import jaxhooks
+
+        jaxhooks.mark_steady()  # the timed loop must not retrace
 
     # 200 reps by default (round 5): at 10 reps the timed window is ~10ms
     # and the tunneled chip's dispatch noise gives up to 3.7x same-config
@@ -251,11 +288,14 @@ def measure():
     # well under a second of device time
     reps = int(os.environ.get("BENCH_REPS", 200))
     t0 = time.time()
-    for r in range(reps):
-        keys = jax.random.split(jax.random.PRNGKey(2 + r), batch)
-        out = run(variables, binst, bjobs, keys)
-    jax.block_until_ready(out)
+    with span("bench/timed"):
+        for r in range(reps):
+            keys = jax.random.split(jax.random.PRNGKey(2 + r), batch)
+            out = run(variables, binst, bjobs, keys)
+        jax.block_until_ready(out)
     dt = time.time() - t0
+    if runlog is not None:
+        runlog.phase("bench/timed", dt, reps=reps, batch=batch)
 
     eps = batch * reps / dt
     steps_per_sec = reps / dt
@@ -335,6 +375,7 @@ def measure():
             "source": f"benchmarks/{name}",
         }
         break
+    obs.finish_run(runlog)
     print(json.dumps(rec))
 
 

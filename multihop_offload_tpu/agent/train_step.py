@@ -36,7 +36,6 @@ from multihop_offload_tpu.agent.actor import (
     actor_delay_matrix,
     compat_cycled_diagonal,
     default_support,
-    lambdas_to_delay_matrix,
 )
 from multihop_offload_tpu.env.apsp import (
     apsp_minplus,

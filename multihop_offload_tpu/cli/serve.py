@@ -60,11 +60,13 @@ def build_service(cfg: Config, pool=None):
 def main(argv=None):
     import time
 
+    from multihop_offload_tpu import obs
     from multihop_offload_tpu.train.tb_logging import ScalarLogger
     from multihop_offload_tpu.utils.platform import apply_platform_env
 
     apply_platform_env()
     cfg = from_args(argv)
+    runlog = obs.start_run(cfg, role="serve")
     service, pool = build_service(cfg)
     tb = ScalarLogger(cfg.tb_logdir or None)
 
@@ -95,6 +97,7 @@ def main(argv=None):
             service.stats.log_tb(tb, service.stats.ticks, service.queue_depth)
     tb.flush()
     summary = service.stats.summary(wall_s=time.monotonic() - t0)
+    obs.finish_run(runlog)
     print(json.dumps(summary, indent=2))
     return summary
 

@@ -135,6 +135,15 @@ class Config:
     tb_logdir: str = ""            # TensorBoard scalars ("" = disabled); the
     #                                working version of the reference's
     #                                disabled log_init/log_scalar hooks
+    # ---- observability (obs/ subsystem; docs/OPERATIONS.md) ----------------
+    obs_log: str = ""              # structured JSONL run-log sink ("" =
+    #                                disabled): manifest header + typed
+    #                                step/tick/checkpoint events; render with
+    #                                `mho-obs <path>`.  Enabling also installs
+    #                                the jax retrace/compile listeners
+    obs_prom: str = ""             # write the final metric-registry snapshot
+    #                                as Prometheus text exposition to this
+    #                                path at loop exit ("" = disabled)
 
     @property
     def jnp_dtype(self):

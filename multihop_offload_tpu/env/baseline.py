@@ -8,8 +8,6 @@ compute).
 
 from __future__ import annotations
 
-import jax.numpy as jnp
-
 from multihop_offload_tpu.graphs.instance import Instance
 
 

@@ -18,7 +18,6 @@ last-write-wins job ordering.
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 from flax import struct
 from jax import lax

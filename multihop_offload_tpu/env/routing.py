@@ -10,7 +10,6 @@ the critic needs (`gnn_offloading_agent.py:310-331`).
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 from flax import struct
 from jax import lax

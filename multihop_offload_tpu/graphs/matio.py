@@ -20,7 +20,6 @@ from __future__ import annotations
 
 import dataclasses
 import os
-from typing import Optional
 
 import networkx as nx
 import numpy as np

@@ -1,0 +1,67 @@
+"""Unified telemetry: metric registry, structured run log, spans, jax hooks.
+
+One observability surface for every long-running loop (Trainer, Evaluator,
+OffloadService, bench): `registry` holds process-wide counters / gauges /
+histograms with labels and Prometheus text exposition; `events` writes the
+structured JSONL run log (manifest header + typed step/tick/checkpoint
+rows); `spans` provides nested host spans that bridge into device profiles
+via `jax.profiler.TraceAnnotation` (absorbing `utils.profiling`);
+`jaxhooks` counts retraces/compiles via `jax.monitoring` and attributes
+them to the active span's phase; `report` renders the JSONL into the
+human-readable run report (`mho-obs`).
+"""
+
+from multihop_offload_tpu.obs.events import (  # noqa: F401
+    RunLog,
+    get_run_log,
+    read_events,
+    run_manifest,
+    set_run_log,
+)
+from multihop_offload_tpu.obs.registry import (  # noqa: F401
+    MetricRegistry,
+    registry,
+)
+from multihop_offload_tpu.obs.spans import (  # noqa: F401
+    current_phase,
+    phase_stats,
+    reset_phases,
+    span,
+)
+
+
+def start_run(cfg, role: str):
+    """The one-call enabling switch the entry points share: when
+    ``cfg.obs_log`` is set, install the jax retrace/compile hooks, open the
+    JSONL run log there (manifest header included) and make it the active
+    sink; returns the RunLog, or None when observability is disabled."""
+    path = getattr(cfg, "obs_log", "")
+    if not path:
+        return None
+    from multihop_offload_tpu.obs import jaxhooks
+
+    jaxhooks.install()
+    log = RunLog(path, manifest=run_manifest(cfg, role=role))
+    log.prom_path = getattr(cfg, "obs_prom", "") or None
+    set_run_log(log)
+    return log
+
+
+def finish_run(log, registry_=None) -> None:
+    """Close an enabled run log: record device-memory gauges, append the
+    summary event (phase-time table + full metric snapshot), optionally
+    dump the Prometheus exposition, and detach the active-sink slot."""
+    if log is None:
+        return
+    from multihop_offload_tpu.obs import jaxhooks
+
+    jaxhooks.record_device_memory()
+    reg = registry_ if registry_ is not None else registry()
+    log.summary(phases=phase_stats(), metrics=reg.snapshot())
+    prom = getattr(log, "prom_path", None)
+    if prom:
+        with open(prom, "w") as f:
+            f.write(reg.prometheus_text())
+    if get_run_log() is log:
+        set_run_log(None)
+    log.close()

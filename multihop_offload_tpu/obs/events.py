@@ -1,0 +1,195 @@
+"""Structured JSONL run log: manifest header + typed event rows.
+
+One `run.jsonl` per instrumented run.  Line 1 is the run manifest (git sha,
+jax version, device kind, platform, config hash, ...); every later line is
+one event: `{"event": <type>, "ts": <unix seconds>, ...fields}`.  Typed
+helpers (`step`, `tick`, `checkpoint`, `phase`, `summary`) keep the schema
+consistent across Trainer / Evaluator / OffloadService / bench so
+`obs.report` (the `mho-obs` CLI) can render any run the same way.
+
+Writes are lock-guarded (the serve tick loop and a main thread may share
+one log) and line-buffered to bound instrumentation overhead; `close()`
+and `summary()` flush.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+from typing import Iterator, Optional
+
+SCHEMA_VERSION = 1
+
+# event types with a typed helper; emit() accepts any type, the report
+# renders unknown ones generically
+EVENT_TYPES = ("manifest", "step", "tick", "epoch", "checkpoint", "phase",
+               "span", "summary")
+
+
+def _git_sha() -> Optional[str]:
+    try:
+        import subprocess
+
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=5,
+        )
+        return out.stdout.strip() or None if out.returncode == 0 else None
+    except Exception:
+        return None
+
+
+def config_hash(cfg) -> Optional[str]:
+    """Stable short hash of the run configuration (dataclass or dict)."""
+    try:
+        import dataclasses
+
+        d = dataclasses.asdict(cfg) if dataclasses.is_dataclass(cfg) else dict(cfg)
+        blob = json.dumps(d, sort_keys=True, default=str).encode()
+        return hashlib.sha256(blob).hexdigest()[:16]
+    except Exception:
+        return None
+
+
+def run_manifest(cfg=None, role: str = "") -> dict:
+    """The manifest header fields.  Device facts are best-effort: asking
+    jax for devices can itself fail on a wedged remote backend, and the
+    manifest must never kill the run it describes."""
+    man = {
+        "event": "manifest",
+        "schema_version": SCHEMA_VERSION,
+        "ts": time.time(),
+        "role": role,
+        "pid": os.getpid(),
+        "git_sha": _git_sha(),
+    }
+    try:
+        import platform as _platform
+
+        man["hostname"] = _platform.node()
+        man["python"] = _platform.python_version()
+    except Exception:
+        pass
+    try:
+        import jax
+
+        man["jax_version"] = jax.__version__
+        man["platform"] = jax.default_backend()
+        devs = jax.devices()
+        man["device_kind"] = getattr(devs[0], "device_kind", "") if devs else ""
+        man["device_count"] = len(devs)
+    except Exception as e:
+        man["platform"] = f"unavailable: {e}"
+    if cfg is not None:
+        man["config_hash"] = config_hash(cfg)
+        try:
+            import dataclasses
+
+            if dataclasses.is_dataclass(cfg):
+                man["config"] = {
+                    k: v for k, v in dataclasses.asdict(cfg).items()
+                    if isinstance(v, (int, float, str, bool, type(None)))
+                }
+        except Exception:
+            pass
+    return man
+
+
+class RunLog:
+    """Append-only JSONL sink with the manifest as its first line."""
+
+    def __init__(self, path: str, manifest: Optional[dict] = None):
+        self.path = path
+        self._lock = threading.Lock()
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        self._f = open(path, "w", buffering=1)  # line-buffered
+        self._closed = False
+        self._write(manifest if manifest is not None else run_manifest())
+
+    def _write(self, rec: dict) -> None:
+        line = json.dumps(rec, default=str)
+        with self._lock:
+            if not self._closed:
+                self._f.write(line + "\n")
+
+    def emit(self, event: str, **fields) -> None:
+        self._write({"event": event, "ts": time.time(), **fields})
+
+    # ---- typed helpers -----------------------------------------------------
+
+    def step(self, **fields) -> None:
+        """One Trainer/Evaluator step (file visit): epoch, gidx/fid, wall_s,
+        build_s, and whatever scalars the loop wants on the record."""
+        self.emit("step", **fields)
+
+    def tick(self, **fields) -> None:
+        """One serving tick: queue depth, dispatches, degraded, latencies."""
+        self.emit("tick", **fields)
+
+    def checkpoint(self, **fields) -> None:
+        self.emit("checkpoint", **fields)
+
+    def phase(self, name: str, duration_s: float, **fields) -> None:
+        """A coarse named phase (bench build/compile/timed legs)."""
+        self.emit("phase", name=name, duration_s=round(duration_s, 6),
+                  **fields)
+
+    def summary(self, phases: Optional[dict] = None,
+                metrics: Optional[dict] = None, **fields) -> None:
+        self.emit("summary", phases=phases or {}, metrics=metrics or {},
+                  **fields)
+        with self._lock:
+            if not self._closed:
+                self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._closed:
+                self._closed = True
+                self._f.flush()
+                self._f.close()
+
+
+# ---- active-sink slot ------------------------------------------------------
+# Instrumented loops emit through the active run log when one is installed
+# and no-op otherwise, so library code never needs config plumbed through.
+
+_active: Optional[RunLog] = None
+_active_lock = threading.Lock()
+
+
+def set_run_log(log: Optional[RunLog]) -> None:
+    global _active
+    with _active_lock:
+        _active = log
+
+
+def get_run_log() -> Optional[RunLog]:
+    return _active
+
+
+def emit(event: str, **fields) -> None:
+    """Emit to the active run log, if any (the no-config call sites use
+    this: `obs.events.emit('tick', ...)`)."""
+    log = _active
+    if log is not None:
+        log.emit(event, **fields)
+
+
+def read_events(path: str) -> Iterator[dict]:
+    """Iterate a run.jsonl's rows; tolerates a truncated final line (a
+    crashed run's log must still render)."""
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                yield json.loads(line)
+            except ValueError:
+                continue

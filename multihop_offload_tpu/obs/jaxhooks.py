@@ -1,0 +1,135 @@
+"""Retrace/compile tracking via `jax.monitoring`, plus device-memory gauges.
+
+JAX emits duration events at every jaxpr trace (= a jit cache miss: first
+compile OR an unwanted retrace from a changed shape/dtype/static arg) and
+every backend compile.  One process-wide listener routes them into the
+shared registry, attributed to the active span's phase
+(`obs.spans.current_phase()` — thread-local, so the serve tick thread and
+the trainer attribute independently):
+
+    jax_retraces_total{phase=...}            every jaxpr trace
+    jax_compiles_total{phase=...}            every backend compile
+    jax_compile_seconds                      compile wall time histogram
+    jax_unexpected_retraces_total{phase=...} traces AFTER mark_steady()
+
+`mark_steady()` is the loop's declaration that everything it intends to
+run has compiled; any retrace after it is a performance bug (the silent
+recompile class that BENCH rounds could not attribute).  The listener
+registers once per process (jax.monitoring has no scoped deregistration)
+and routes to the CURRENT default registry at event time, so tests that
+reset the registry start from clean counts.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from multihop_offload_tpu.obs.registry import registry as _registry
+from multihop_offload_tpu.obs.spans import current_phase as _current_phase
+
+# event names pinned by jax._src.dispatch (stable across 0.4.x); resolved
+# lazily so a jax relayout only breaks installation, not import
+TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_lock = threading.Lock()
+_installed = False
+_steady = False
+
+
+def _on_duration(event: str, duration_secs: float, **kw) -> None:
+    if event not in (TRACE_EVENT, BACKEND_COMPILE_EVENT):
+        return
+    reg = _registry()
+    phase = _current_phase() or "unattributed"
+    if event == TRACE_EVENT:
+        reg.counter(
+            "jax_retraces_total", "jaxpr traces (jit cache misses)"
+        ).inc(phase=phase)
+        if _steady:
+            reg.counter(
+                "jax_unexpected_retraces_total",
+                "jaxpr traces after the loop declared steady state",
+            ).inc(phase=phase)
+    else:
+        reg.counter(
+            "jax_compiles_total", "XLA backend compiles"
+        ).inc(phase=phase)
+        reg.histogram(
+            "jax_compile_seconds", "XLA backend compile wall seconds"
+        ).observe(duration_secs, phase=phase)
+
+
+def install() -> None:
+    """Idempotently register the monitoring listener (process lifetime)."""
+    global _installed
+    with _lock:
+        if _installed:
+            return
+        import jax.monitoring
+
+        jax.monitoring.register_event_duration_secs_listener(_on_duration)
+        _installed = True
+
+
+def mark_steady() -> None:
+    """Declare that every program this loop runs has been traced/compiled;
+    retraces from here on count as `jax_unexpected_retraces_total`."""
+    global _steady
+    install()
+    _steady = True
+
+
+def clear_steady() -> None:
+    global _steady
+    _steady = False
+
+
+def is_steady() -> bool:
+    return _steady
+
+
+def unexpected_retraces() -> int:
+    """Total unexpected retraces recorded so far (all phases)."""
+    return int(
+        _registry()
+        .counter("jax_unexpected_retraces_total").total()
+    )
+
+
+def retraces() -> int:
+    return int(_registry().counter("jax_retraces_total").total())
+
+
+def record_device_memory(prefix: str = "mho") -> dict:
+    """Snapshot per-device memory stats into gauges (best-effort: CPU and
+    some backends return None).  Returns {device: bytes_in_use} actually
+    recorded."""
+    import jax
+
+    reg = _registry()
+    out = {}
+    try:
+        devices = jax.local_devices()
+    except Exception:
+        return out
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        label = f"{d.platform}:{d.id}"
+        in_use = stats.get("bytes_in_use")
+        if in_use is not None:
+            reg.gauge(
+                f"{prefix}_device_bytes_in_use", "live device allocation"
+            ).set(in_use, device=label)
+            out[label] = int(in_use)
+        peak = stats.get("peak_bytes_in_use")
+        if peak is not None:
+            reg.gauge(
+                f"{prefix}_device_peak_bytes_in_use", "peak device allocation"
+            ).set(peak, device=label)
+    return out

@@ -1,0 +1,237 @@
+"""Process-wide metric registry: counters, gauges, histograms with labels.
+
+The registry is the ONE mutation-safe aggregation point for host-side
+telemetry (the old `utils.profiling._PHASES` was a bare module-global
+defaultdict mutated from both the serve tick loop and the main thread —
+every method here holds the registry lock).  Snapshots are plain nested
+dicts; `prometheus_text()` renders the standard text exposition so a
+scraper (or a golden test) can consume the same state.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, Iterable, Optional, Tuple
+
+# latency-shaped default buckets (seconds), Prometheus-style, +Inf implicit
+DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+    5.0, 10.0, 30.0, 60.0,
+)
+
+_LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Dict[str, object]) -> _LabelKey:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+def _label_str(key: _LabelKey) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+class _Metric:
+    """Shared plumbing: every child series keyed by its sorted label set.
+
+    All mutation goes through the owning registry's lock (`self._lock` IS
+    the registry lock, one per process-wide registry)."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help_: str, lock: threading.RLock):
+        self.name = name
+        self.help = help_
+        self._lock = lock
+        self._series: Dict[_LabelKey, object] = {}
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up")
+        key = _label_key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return float(self._series.get(_label_key(labels), 0.0))
+
+    def total(self) -> float:
+        """Sum over every label combination."""
+        with self._lock:
+            return float(sum(self._series.values()))
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._series[_label_key(labels)] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + amount
+
+    def value(self, **labels) -> Optional[float]:
+        with self._lock:
+            v = self._series.get(_label_key(labels))
+            return None if v is None else float(v)
+
+
+class _HistSeries:
+    __slots__ = ("count", "sum", "min", "max", "bucket_counts")
+
+    def __init__(self, n_buckets: int):
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+        self.bucket_counts = [0] * (n_buckets + 1)  # +Inf tail bucket
+
+
+class Histogram(_Metric):
+    """Fixed-boundary histogram with exact count/sum/min/max per series.
+
+    min/max are first-class (the `phase_stats` shim promises them); bucket
+    counts are cumulative-rendered only at exposition time."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help_: str, lock: threading.RLock,
+                 buckets: Iterable[float] = DEFAULT_BUCKETS):
+        super().__init__(name, help_, lock)
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+
+    def observe(self, value: float, **labels) -> None:
+        key = _label_key(labels)
+        v = float(value)
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                s = self._series[key] = _HistSeries(len(self.buckets))
+            s.count += 1
+            s.sum += v
+            s.min = min(s.min, v)
+            s.max = max(s.max, v)
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    s.bucket_counts[i] += 1
+                    break
+            else:
+                s.bucket_counts[-1] += 1
+
+    def stats(self, **labels) -> Optional[dict]:
+        with self._lock:
+            s = self._series.get(_label_key(labels))
+            if s is None:
+                return None
+            return {
+                "count": s.count, "total_s": s.sum,
+                "mean_s": s.sum / max(s.count, 1),
+                "min_s": s.min, "max_s": s.max,
+            }
+
+
+class MetricRegistry:
+    """Named metric namespace; get-or-create accessors are idempotent and a
+    kind clash (counter re-requested as gauge) fails loudly."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _get(self, cls, name: str, help_: str, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, help_, self._lock, **kw)
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric '{name}' already registered as {m.kind}"
+                )
+            return m
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._get(Counter, name, help_)
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return self._get(Gauge, name, help_)
+
+    def histogram(self, name: str, help_: str = "",
+                  buckets: Iterable[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._get(Histogram, name, help_, buckets=buckets)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+
+    # ---- export ------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Nested plain-dict view: {name: {kind, help, series: {labelstr:
+        value-or-stats}}} — the form the run-log summary event embeds."""
+        out = {}
+        with self._lock:
+            for name, m in sorted(self._metrics.items()):
+                series = {}
+                for key, v in m._series.items():
+                    if isinstance(v, _HistSeries):
+                        series[_label_str(key) or ""] = {
+                            "count": v.count, "sum": v.sum,
+                            "min": (None if v.count == 0 else v.min),
+                            "max": (None if v.count == 0 else v.max),
+                        }
+                    else:
+                        series[_label_str(key) or ""] = v
+                out[name] = {"kind": m.kind, "help": m.help, "series": series}
+        return out
+
+    def prometheus_text(self) -> str:
+        """Standard Prometheus text exposition (histograms render cumulative
+        `_bucket{le=...}` plus `_sum`/`_count`)."""
+        lines = []
+        with self._lock:
+            for name, m in sorted(self._metrics.items()):
+                if m.help:
+                    lines.append(f"# HELP {name} {m.help}")
+                lines.append(f"# TYPE {name} {m.kind}")
+                for key in sorted(m._series):
+                    v = m._series[key]
+                    if isinstance(v, _HistSeries):
+                        cum = 0
+                        assert isinstance(m, Histogram)
+                        for b, c in zip(m.buckets, v.bucket_counts):
+                            cum += c
+                            labels = key + (("le", repr(b)),)
+                            lines.append(
+                                f"{name}_bucket{_label_str(tuple(sorted(labels)))} {cum}"
+                            )
+                        cum += v.bucket_counts[-1]
+                        inf = key + (("le", "+Inf"),)
+                        lines.append(
+                            f"{name}_bucket{_label_str(tuple(sorted(inf)))} {cum}"
+                        )
+                        lines.append(f"{name}_sum{_label_str(key)} {v.sum}")
+                        lines.append(f"{name}_count{_label_str(key)} {v.count}")
+                    else:
+                        fv = float(v)
+                        sv = repr(int(fv)) if fv == int(fv) else repr(fv)
+                        lines.append(f"{name}{_label_str(key)} {sv}")
+        return "\n".join(lines) + "\n"
+
+
+_DEFAULT = MetricRegistry()
+
+
+def registry() -> MetricRegistry:
+    """The process-wide default registry every instrumented loop shares."""
+    return _DEFAULT

@@ -1,0 +1,193 @@
+"""Run-report rendering: `run.jsonl` -> the human-readable operator view.
+
+Answers the questions a BENCH round needs answered without re-running
+anything: where did wall time go (per-phase table, input-wait vs device
+split), did anything recompile after steady state (retrace counters), what
+did serving look like (queue depth, degradation, padding waste).  Pure
+parsing — no jax import — so the CLI runs anywhere.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional
+
+from multihop_offload_tpu.obs.events import read_events
+
+# phase-name classification for the input-wait vs device split; host-input
+# phases end in /build or /prefetch (the drivers' convention), device-side
+# phases are the dispatch+block windows
+_INPUT_SUFFIXES = ("/build", "/prefetch", "/pack")
+_DEVICE_SUFFIXES = ("/step", "/tick", "/replay", "/timed", "/warmup")
+
+
+def classify_phase(name: str) -> str:
+    if name.endswith(_INPUT_SUFFIXES):
+        return "input-wait"
+    if name.endswith(_DEVICE_SUFFIXES):
+        return "device"
+    if "compile" in name:
+        return "compile"
+    return "other"
+
+
+def load_run(path: str) -> dict:
+    """Parse a run.jsonl into {manifest, counts, phases, metrics, events}."""
+    manifest: Optional[dict] = None
+    counts: Dict[str, int] = {}
+    phases: Dict[str, dict] = {}
+    metrics: Dict[str, dict] = {}
+    last_of: Dict[str, dict] = {}
+    first_ts = last_ts = None
+    for ev in read_events(path):
+        et = ev.get("event", "?")
+        ts = ev.get("ts")
+        if isinstance(ts, (int, float)):
+            first_ts = ts if first_ts is None else first_ts
+            last_ts = ts
+        if et == "manifest" and manifest is None:
+            manifest = ev
+            continue
+        counts[et] = counts.get(et, 0) + 1
+        last_of[et] = ev
+        if et == "phase":
+            # standalone phase rows (bench legs) aggregate like span stats
+            p = phases.setdefault(ev.get("name", "?"), {
+                "count": 0, "total_s": 0.0, "min_s": None, "max_s": None,
+            })
+            d = float(ev.get("duration_s", 0.0))
+            p["count"] += 1
+            p["total_s"] += d
+            p["min_s"] = d if p["min_s"] is None else min(p["min_s"], d)
+            p["max_s"] = d if p["max_s"] is None else max(p["max_s"], d)
+        elif et == "summary":
+            for name, s in (ev.get("phases") or {}).items():
+                phases[name] = dict(s)
+            metrics = ev.get("metrics") or metrics
+    for p in phases.values():
+        p.setdefault("mean_s", p["total_s"] / max(p.get("count", 1), 1))
+    return {
+        "manifest": manifest or {},
+        "counts": counts,
+        "phases": phases,
+        "metrics": metrics,
+        "last": last_of,
+        "wall_s": (last_ts - first_ts) if first_ts is not None else None,
+    }
+
+
+def _counter_total(metrics: dict, name: str) -> float:
+    m = metrics.get(name)
+    if not m:
+        return 0.0
+    return float(sum(v for v in m["series"].values()
+                     if isinstance(v, (int, float))))
+
+
+def _counter_by_label(metrics: dict, name: str) -> Dict[str, float]:
+    m = metrics.get(name)
+    if not m:
+        return {}
+    return {k or "(total)": float(v) for k, v in m["series"].items()
+            if isinstance(v, (int, float))}
+
+
+def _fmt_row(cells: Iterable[str], widths: List[int]) -> str:
+    return "  ".join(str(c).ljust(w) for c, w in zip(cells, widths)).rstrip()
+
+
+def _table(header: List[str], rows: List[List[str]]) -> List[str]:
+    widths = [max(len(str(h)), *(len(str(r[i])) for r in rows))
+              if rows else len(str(h)) for i, h in enumerate(header)]
+    out = [_fmt_row(header, widths),
+           _fmt_row(["-" * w for w in widths], widths)]
+    out += [_fmt_row(r, widths) for r in rows]
+    return out
+
+
+def render_report(path: str) -> str:
+    run = load_run(path)
+    man, phases, metrics = run["manifest"], run["phases"], run["metrics"]
+    lines: List[str] = []
+
+    lines.append(f"run report — {path}")
+    lines.append("")
+    lines.append("manifest")
+    for key in ("role", "git_sha", "jax_version", "platform", "device_kind",
+                "device_count", "config_hash", "hostname"):
+        if key in man and man[key] not in (None, ""):
+            lines.append(f"  {key:<13} {man[key]}")
+    if run["wall_s"] is not None:
+        lines.append(f"  {'wall_s':<13} {run['wall_s']:.3f}")
+    ev_counts = ", ".join(f"{k}={v}" for k, v in sorted(run["counts"].items()))
+    lines.append(f"  {'events':<13} {ev_counts or '(none)'}")
+    lines.append("")
+
+    if phases:
+        lines.append("per-phase time")
+        total = sum(p.get("total_s", 0.0) for p in phases.values()) or 1.0
+        rows = []
+        split: Dict[str, float] = {}
+        for name in sorted(phases, key=lambda n: -phases[n].get("total_s", 0)):
+            p = phases[name]
+            split[classify_phase(name)] = (
+                split.get(classify_phase(name), 0.0) + p.get("total_s", 0.0)
+            )
+            rows.append([
+                name, p.get("count", 0),
+                f"{p.get('total_s', 0.0):.3f}",
+                f"{1e3 * p.get('mean_s', 0.0):.2f}",
+                f"{1e3 * (p.get('min_s') or 0.0):.2f}",
+                f"{1e3 * (p.get('max_s') or 0.0):.2f}",
+                f"{100.0 * p.get('total_s', 0.0) / total:.1f}%",
+            ])
+        lines += [
+            "  " + ln for ln in
+            _table(["phase", "count", "total_s", "mean_ms", "min_ms",
+                    "max_ms", "share"], rows)
+        ]
+        acc = " | ".join(
+            f"{k} {100.0 * v / total:.1f}% ({v:.3f}s)"
+            for k, v in sorted(split.items(), key=lambda kv: -kv[1])
+        )
+        lines.append(f"  split: {acc}")
+        lines.append("")
+
+    retr = _counter_total(metrics, "jax_retraces_total")
+    unexp = _counter_total(metrics, "jax_unexpected_retraces_total")
+    compiles = _counter_total(metrics, "jax_compiles_total")
+    lines.append("compilation")
+    lines.append(f"  jaxpr traces (cache misses)  {int(retr)}")
+    lines.append(f"  backend compiles             {int(compiles)}")
+    flag = "  <-- PERF BUG: recompile after steady state" if unexp else ""
+    lines.append(f"  unexpected retraces          {int(unexp)}{flag}")
+    by_phase = _counter_by_label(metrics, "jax_unexpected_retraces_total")
+    if unexp and by_phase:
+        for lab, v in sorted(by_phase.items(), key=lambda kv: -kv[1]):
+            lines.append(f"    {lab} {int(v)}")
+    lines.append("")
+
+    serve_counters = {
+        name: _counter_by_label(metrics, name) for name in metrics
+        if name.startswith("mho_serve_")
+    }
+    if serve_counters:
+        lines.append("serving")
+        for name in sorted(serve_counters):
+            for lab, v in sorted(serve_counters[name].items()):
+                tag = f"{name}{'' if lab == '(total)' else lab}"
+                val = int(v) if float(v) == int(v) else round(v, 4)
+                lines.append(f"  {tag:<42} {val}")
+        last_tick = run["last"].get("tick")
+        if last_tick and "queue_depth" in last_tick:
+            lines.append(f"  {'queue_depth (last tick)':<42} "
+                         f"{last_tick['queue_depth']}")
+        lines.append("")
+
+    mem = _counter_by_label(metrics, "mho_device_peak_bytes_in_use")
+    if mem:
+        lines.append("device memory (peak bytes)")
+        for lab, v in sorted(mem.items()):
+            lines.append(f"  {lab:<20} {int(v)}")
+        lines.append("")
+
+    return "\n".join(lines).rstrip() + "\n"

@@ -1,0 +1,139 @@
+"""Nested host spans with trace ids, bridged into device profiles.
+
+A span measures a named stretch of host wall-clock, nests (thread-local
+stack), carries a trace id shared by the whole nest, and — because JAX work
+is async — optionally blocks on device effects so the measured window
+covers execution rather than dispatch.  Every span is wrapped in
+`jax.profiler.TraceAnnotation`, so when a device trace is being captured
+(`trace(logdir)`) the same names appear on the TensorBoard profile
+timeline, linking host accounting to device activity.
+
+Durations aggregate into the shared registry histogram
+`mho_phase_seconds{phase=...}` — the lock-guarded replacement for the old
+`utils.profiling._PHASES` module global; `phase_timer` / `phase_stats` /
+`reset_phases` remain as shims over it (now with min/max).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+import time
+from typing import Iterator, Optional
+
+from multihop_offload_tpu.obs.registry import registry as _registry
+
+_ids = itertools.count(1)
+_tls = threading.local()
+
+PHASE_METRIC = "mho_phase_seconds"
+
+
+def _stack():
+    s = getattr(_tls, "stack", None)
+    if s is None:
+        s = _tls.stack = []
+    return s
+
+
+def current_phase() -> str:
+    """Innermost active span name on this thread ('' outside any span) —
+    the attribution label `obs.jaxhooks` stamps on retrace/compile events."""
+    s = _stack()
+    return s[-1]["name"] if s else ""
+
+
+def current_trace_id() -> Optional[str]:
+    s = _stack()
+    return s[-1]["trace_id"] if s else None
+
+
+@contextlib.contextmanager
+def span(name: str, block: bool = False, emit: bool = False,
+         **attrs) -> Iterator[dict]:
+    """Measure `name` as a nested span.
+
+    `block=True` waits for outstanding device effects before closing, so
+    the span covers execution, not just async dispatch.  `emit=True`
+    additionally writes a `span` event row to the active run log (off by
+    default — per-step spans aggregate in the registry; event rows are for
+    coarse, low-rate spans).  Yields the span record (id/parent/trace id),
+    usable for correlation."""
+    import jax
+
+    stack = _stack()
+    sid = next(_ids)
+    rec = {
+        "name": name,
+        "span_id": f"{sid:x}",
+        "parent_id": stack[-1]["span_id"] if stack else None,
+        "trace_id": stack[-1]["trace_id"] if stack else f"{sid:08x}",
+    }
+    stack.append(rec)
+    t0 = time.perf_counter()
+    try:
+        with jax.profiler.TraceAnnotation(name):
+            yield rec
+    finally:
+        if block:
+            jax.effects_barrier()
+        dt = time.perf_counter() - t0
+        stack.pop()
+        _registry().histogram(
+            PHASE_METRIC, "host span / phase wall seconds"
+        ).observe(dt, phase=name)
+        if emit:
+            from multihop_offload_tpu.obs import events as _events
+
+            log = _events.get_run_log()
+            if log is not None:
+                log.emit("span", duration_s=round(dt, 6), **rec, **attrs)
+
+
+# ---- utils.profiling compatibility shims ----------------------------------
+
+@contextlib.contextmanager
+def phase_timer(name: str, block: bool = False) -> Iterator[None]:
+    """Legacy name for a non-emitting span (kept for existing call sites)."""
+    with span(name, block=block):
+        yield
+
+
+def phase_stats() -> dict:
+    """Per-phase aggregates {name: {count, total_s, mean_s, min_s, max_s}}
+    from the shared registry (min/max are new vs the old module-global)."""
+    snap = _registry().snapshot().get(PHASE_METRIC)
+    if not snap:
+        return {}
+    out = {}
+    for labels, s in snap["series"].items():
+        # labels renders as '{phase="<name>"}'
+        name = labels.split('"')[1] if '"' in labels else labels
+        out[name] = {
+            "count": s["count"], "total_s": s["sum"],
+            "mean_s": s["sum"] / max(s["count"], 1),
+            "min_s": s["min"], "max_s": s["max"],
+        }
+    return out
+
+
+def reset_phases() -> None:
+    """Drop accumulated phase aggregates (tests / fresh measurement legs).
+    Resets only the phase histogram, not unrelated metrics."""
+    reg = _registry()
+    with reg._lock:
+        reg._metrics.pop(PHASE_METRIC, None)
+
+
+@contextlib.contextmanager
+def trace(logdir: str) -> Iterator[None]:
+    """Device profile trace (view with TensorBoard's profile plugin); host
+    spans inside the window appear as TraceAnnotations on the timeline."""
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

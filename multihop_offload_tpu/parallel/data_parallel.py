@@ -16,9 +16,6 @@ Two update rules:
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
 import optax

@@ -18,7 +18,6 @@ row counts divisible by the axis size.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Callable
 
 import jax.numpy as jnp

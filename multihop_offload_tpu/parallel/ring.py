@@ -14,7 +14,6 @@ All functions run inside `shard_map` with `axis_name` bound.
 from __future__ import annotations
 
 import math
-from functools import partial
 
 import jax
 import jax.numpy as jnp

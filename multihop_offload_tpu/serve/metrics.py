@@ -5,6 +5,12 @@ to the operator dashboard numbers (decisions/sec, p50/p99 latency, per-bucket
 occupancy, padding waste, dispatches/request) and exported through the
 existing plumbing: `train.metrics.summarize_latencies` for the quantile math
 and `train.tb_logging.ScalarLogger` for TensorBoard.
+
+Every mutation also mirrors into the process-wide `obs.registry` under
+`mho_serve_*`, so one Prometheus scrape / `mho-obs` report covers serving
+alongside the train/eval phase metrics — `ServingStats` stays the
+per-service lifetime record (and `benchmarks/serving.json` schema), the
+registry is the cross-subsystem export.
 """
 
 from __future__ import annotations
@@ -12,6 +18,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, List
 
+from multihop_offload_tpu.obs.registry import registry as _registry
 from multihop_offload_tpu.train.metrics import summarize_latencies
 from multihop_offload_tpu.train.tb_logging import ScalarLogger
 
@@ -44,6 +51,22 @@ class ServingStats:
     def bucket(self, b: int) -> _BucketStats:
         return self.buckets.setdefault(b, _BucketStats())
 
+    def record_submit(self, outcome: str) -> None:
+        """One admission decision: 'admitted', 'backpressure' (bounded-queue
+        refusal) or 'too_large' (no bucket fits)."""
+        self.submitted += 1
+        if outcome == "admitted":
+            self.admitted += 1
+        elif outcome == "backpressure":
+            self.rejected += 1
+        elif outcome == "too_large":
+            self.too_large += 1
+        else:
+            raise ValueError(f"unknown submit outcome '{outcome}'")
+        _registry().counter(
+            "mho_serve_submits_total", "admission decisions by outcome"
+        ).inc(outcome=outcome)
+
     def record_dispatch(self, b: int, n_real: int, slots: int, waste: dict,
                         degraded: bool) -> None:
         s = self.bucket(b)
@@ -53,6 +76,38 @@ class ServingStats:
         s.occupancy_sum += n_real / slots
         s.waste_jobs_sum += waste["jobs"]
         s.waste_nodes_sum += waste["nodes"]
+        reg = _registry()
+        reg.counter(
+            "mho_serve_dispatches_total", "fused device programs dispatched"
+        ).inc(bucket=str(b), served_by="baseline" if degraded else "gnn")
+        reg.counter(
+            "mho_serve_pad_waste_jobs_total",
+            "padded job slots computed and discarded",
+        ).inc(waste["jobs"], bucket=str(b))
+
+    def record_batch(self, n_real: int, decisions: int, degraded: bool,
+                     latencies_s: List[float]) -> None:
+        """One served batch's responses: counts plus per-request queue+serve
+        latencies (mirrored into the `mho_serve_latency_seconds` histogram)."""
+        self.served += n_real
+        self.degraded += n_real if degraded else 0
+        self.decisions += decisions
+        self.latencies_s.extend(latencies_s)
+        reg = _registry()
+        reg.counter(
+            "mho_serve_served_total", "requests answered"
+        ).inc(n_real, served_by="baseline" if degraded else "gnn")
+        if degraded:
+            reg.counter(
+                "mho_serve_degraded_total",
+                "requests served by the analytic baseline under deadline "
+                "pressure",
+            ).inc(n_real)
+        lat = reg.histogram(
+            "mho_serve_latency_seconds", "request queue+serve latency"
+        )
+        for x in latencies_s:
+            lat.observe(x)
 
     @property
     def dispatches(self) -> int:

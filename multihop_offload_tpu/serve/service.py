@@ -26,6 +26,9 @@ from typing import Callable, Deque, List, Optional, Tuple
 import jax
 import numpy as np
 
+from multihop_offload_tpu.obs import events as obs_events
+from multihop_offload_tpu.obs.registry import registry as obs_registry
+from multihop_offload_tpu.obs.spans import span
 from multihop_offload_tpu.serve.bucketing import (
     ShapeBuckets,
     pack_bucket,
@@ -87,16 +90,18 @@ class OffloadService:
         """Admit a request, or refuse it (False) under backpressure / when no
         bucket fits.  Refusal is the client's signal to retry elsewhere —
         a bounded queue keeps the p99 of everything already admitted."""
-        self.stats.submitted += 1
         b = self.buckets.bucket_for(*req.sizes)
         if b is None:
-            self.stats.too_large += 1
+            self.stats.record_submit("too_large")
             return False
         if self.queue_depth >= self.queue_cap:
-            self.stats.rejected += 1
+            self.stats.record_submit("backpressure")
             return False
         self._queues[b].append((req, self.clock() if now is None else now))
-        self.stats.admitted += 1
+        self.stats.record_submit("admitted")
+        obs_registry().gauge(
+            "mho_serve_queue_depth", "pending admitted requests"
+        ).set(self.queue_depth)
         return True
 
     # ---- the serving tick --------------------------------------------------
@@ -108,36 +113,49 @@ class OffloadService:
         """Serve one batch per non-empty bucket; returns demuxed responses."""
         self.stats.ticks += 1
         responses: List[OffloadResponse] = []
-        for b, q in enumerate(self._queues):
-            if not q:
-                continue
-            t_now = self.clock() if now is None else now
-            degraded = (t_now - q[0][1]) > self.deadline_s
-            taken = [q.popleft() for _ in range(min(self.slots, len(q)))]
-            reqs = [r for r, _ in taken]
-            pad = self.buckets[b]
-            binst, bjobs = pack_bucket(
-                reqs, pad, self.slots, dtype=self.dtype,
-                hop_cache=self._hop_cache,
-            )
-            keys = [self.request_key(r.request_id) for r in reqs]
-            while len(keys) < self.slots:   # pad slots reuse the last key
-                keys.append(keys[-1])
-            out = self.executor.run(
-                b, binst, bjobs, np.stack([np.asarray(k) for k in keys]),
-                degraded=degraded,
-            )
-            t_done = self.clock() if now is None else now
-            responses.extend(demux_responses(
-                taken, out, "baseline" if degraded else "gnn", b, t_done
-            ))
-            waste = padding_waste(reqs, pad, self.slots)
-            self.stats.record_dispatch(b, len(reqs), self.slots, waste, degraded)
-            self.stats.served += len(reqs)
-            self.stats.degraded += len(reqs) if degraded else 0
-            self.stats.decisions += sum(r.num_jobs for r in reqs)
-            self.stats.latencies_s.extend(
-                max(t_done - t_enq, 0.0) for _, t_enq in taken
+        degraded_batches = 0
+        with span("serve/tick"):
+            for b, q in enumerate(self._queues):
+                if not q:
+                    continue
+                t_now = self.clock() if now is None else now
+                degraded = (t_now - q[0][1]) > self.deadline_s
+                degraded_batches += int(degraded)
+                taken = [q.popleft() for _ in range(min(self.slots, len(q)))]
+                reqs = [r for r, _ in taken]
+                pad = self.buckets[b]
+                with span("serve/pack"):
+                    binst, bjobs = pack_bucket(
+                        reqs, pad, self.slots, dtype=self.dtype,
+                        hop_cache=self._hop_cache,
+                    )
+                keys = [self.request_key(r.request_id) for r in reqs]
+                while len(keys) < self.slots:   # pad slots reuse the last key
+                    keys.append(keys[-1])
+                out = self.executor.run(
+                    b, binst, bjobs, np.stack([np.asarray(k) for k in keys]),
+                    degraded=degraded,
+                )
+                t_done = self.clock() if now is None else now
+                responses.extend(demux_responses(
+                    taken, out, "baseline" if degraded else "gnn", b, t_done
+                ))
+                waste = padding_waste(reqs, pad, self.slots)
+                self.stats.record_dispatch(
+                    b, len(reqs), self.slots, waste, degraded
+                )
+                self.stats.record_batch(
+                    len(reqs), sum(r.num_jobs for r in reqs), degraded,
+                    [max(t_done - t_enq, 0.0) for _, t_enq in taken],
+                )
+        depth = self.queue_depth
+        obs_registry().gauge(
+            "mho_serve_queue_depth", "pending admitted requests"
+        ).set(depth)
+        if responses:
+            obs_events.emit(
+                "tick", n=self.stats.ticks, served=len(responses),
+                degraded_batches=degraded_batches, queue_depth=depth,
             )
         return responses
 
@@ -155,7 +173,14 @@ class OffloadService:
     def hot_reload(self, model_dir: str, which: str = "orbax") -> Optional[int]:
         """Poll the orbax tree and swap in a newer policy without restarting
         (compiled programs take weights as arguments — no retrace)."""
-        return self.executor.hot_reload(model_dir, which=which)
+        step = self.executor.hot_reload(model_dir, which=which)
+        if step is not None:
+            obs_registry().counter(
+                "mho_serve_hot_reloads_total",
+                "policy swaps without restart",
+            ).inc()
+            obs_events.emit("hot_reload", step=step)
+        return step
 
 
 def demux_responses(

@@ -10,7 +10,6 @@ per-instance runtime by method (Fig. 2(c)), and the live-training monitor
 from __future__ import annotations
 
 import os
-from typing import Optional
 
 import numpy as np
 import pandas as pd

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 import pandas as pd
 
+from multihop_offload_tpu import obs
 from multihop_offload_tpu.agent import (
     forward_backward,
     forward_env,
@@ -34,6 +35,8 @@ from multihop_offload_tpu.agent import (
     replay_remember,
 )
 from multihop_offload_tpu.config import Config
+from multihop_offload_tpu.obs import jaxhooks
+from multihop_offload_tpu.obs.spans import span
 from multihop_offload_tpu.env import baseline_policy, local_policy
 from multihop_offload_tpu.models import load_reference_checkpoint, make_model
 from multihop_offload_tpu.train import checkpoints as ckpt_lib
@@ -544,6 +547,10 @@ class Trainer(_Harness):
                 self.best_tau = float(json.load(f)["rolling_gnn_test_tau"])
         gidx = getattr(self, "_resume_step", 0)
         tb = ScalarLogger(cfg.tb_logdir if self.is_host0 else None)
+        # structured telemetry (docs/OPERATIONS.md "Observability"): JSONL
+        # run log + retrace hooks when cfg.obs_log is set; process 0 only —
+        # multi-host runs share a filesystem like the CSV/TB sinks do
+        runlog = obs.start_run(cfg, role="train") if self.is_host0 else None
         from multihop_offload_tpu.graphs.instance import to_device
 
         def _build_file(fid):
@@ -553,13 +560,14 @@ class Trainer(_Harness):
             draw order of the sequential loop (build fid, build fid+1, ...)
             so seeded runs stay bit-identical."""
             t0 = time.time()
-            rec = self.data.records[fid]
-            inst = to_device(self.data.instance(fid, self.rng))
-            jobsets, counts = sample_jobsets(
-                rec, self.data.pad_of(fid), cfg.num_instances, self.rng,
-                cfg.arrival_scale, ul=cfg.ul_data, dl=cfg.dl_data,
-                dtype=cfg.jnp_dtype,
-            )
+            with span("train/build"):
+                rec = self.data.records[fid]
+                inst = to_device(self.data.instance(fid, self.rng))
+                jobsets, counts = sample_jobsets(
+                    rec, self.data.pad_of(fid), cfg.num_instances, self.rng,
+                    cfg.arrival_scale, ul=cfg.ul_data, dl=cfg.dl_data,
+                    dtype=cfg.jnp_dtype,
+                )
             return (rec, inst, jobsets, counts), time.time() - t0
 
         for epoch in range(epochs if epochs is not None else cfg.epochs):
@@ -575,37 +583,39 @@ class Trainer(_Harness):
             for fid in order:
                 rec, inst, jobsets, counts = pf.current()
                 t0 = time.time()
-                if self.n_dp > 1:
-                    # pad the episode batch to a device-divisible width; the
-                    # valid mask keeps pad episodes out of the replay buffer
-                    b = cfg.num_instances
-                    bp = -(-b // self.n_dp) * self.n_dp
-                    jobsets_p = _pad_leading(jobsets, bp)
-                    valid = jnp.arange(bp) < b
-                    self.memory, gnn_totals, loss_c, loss_m = self._gnn_train_step_dp(
-                        self.variables, self.memory, inst, jobsets_p,
-                        self.next_keys(bp), valid,
-                        jnp.asarray(explore, cfg.jnp_dtype),
-                    )
-                    bl, loc, gnn_test = self._eval_methods_dp(
-                        self.variables, inst, jobsets_p, self.next_keys(bp)
-                    )
-                    gnn_totals, loss_c, loss_m, bl, loc, gnn_test = (
-                        x[:b] for x in
-                        (gnn_totals, loss_c, loss_m, bl, loc, gnn_test)
-                    )
-                else:
-                    self.memory, gnn_totals, loss_c, loss_m = self._gnn_train_step(
-                        self.variables, self.memory, inst, jobsets,
-                        self.next_keys(cfg.num_instances),
-                        jnp.asarray(explore, cfg.jnp_dtype),
-                    )
-                    bl, loc, gnn_test = self._eval_methods(
-                        self.variables, inst, jobsets,
-                        self.next_keys(cfg.num_instances)
-                    )
-                next_build_s = pf.prefetch_next()
-                jax.block_until_ready(gnn_test)
+                with span("train/step"):
+                    if self.n_dp > 1:
+                        # pad the episode batch to a device-divisible width;
+                        # the valid mask keeps pad episodes out of the
+                        # replay buffer
+                        b = cfg.num_instances
+                        bp = -(-b // self.n_dp) * self.n_dp
+                        jobsets_p = _pad_leading(jobsets, bp)
+                        valid = jnp.arange(bp) < b
+                        self.memory, gnn_totals, loss_c, loss_m = self._gnn_train_step_dp(
+                            self.variables, self.memory, inst, jobsets_p,
+                            self.next_keys(bp), valid,
+                            jnp.asarray(explore, cfg.jnp_dtype),
+                        )
+                        bl, loc, gnn_test = self._eval_methods_dp(
+                            self.variables, inst, jobsets_p, self.next_keys(bp)
+                        )
+                        gnn_totals, loss_c, loss_m, bl, loc, gnn_test = (
+                            x[:b] for x in
+                            (gnn_totals, loss_c, loss_m, bl, loc, gnn_test)
+                        )
+                    else:
+                        self.memory, gnn_totals, loss_c, loss_m = self._gnn_train_step(
+                            self.variables, self.memory, inst, jobsets,
+                            self.next_keys(cfg.num_instances),
+                            jnp.asarray(explore, cfg.jnp_dtype),
+                        )
+                        bl, loc, gnn_test = self._eval_methods(
+                            self.variables, inst, jobsets,
+                            self.next_keys(cfg.num_instances)
+                        )
+                    next_build_s = pf.prefetch_next()
+                    jax.block_until_ready(gnn_test)
                 # runtime approximates METHOD compute only, net of the
                 # overlapped successor build — the reference's timer likewise
                 # excludes file prep (`AdHoc_test.py:126`).  With host and
@@ -618,11 +628,12 @@ class Trainer(_Harness):
                     self.mem_count + cfg.num_instances, self.memory.loss_critic.shape[0]
                 )
 
-                metrics = _method_metrics(
-                    {"baseline": bl, "local": loc, "GNN": gnn_totals,
-                     "GNN-test": gnn_test},
-                    bl, jobsets.mask, float(cfg.T),
-                )
+                with span("train/metrics"):
+                    metrics = _method_metrics(
+                        {"baseline": bl, "local": loc, "GNN": gnn_totals,
+                         "GNN-test": gnn_test},
+                        bl, jobsets.mask, float(cfg.T),
+                    )
                 rows += _rows(rec, counts, metrics, runtime, gidx)
 
                 # best-checkpoint tracking on rolling GNN-test tau
@@ -632,21 +643,28 @@ class Trainer(_Harness):
                     if len(best_roll) == cfg.best_window and roll < self.best_tau:
                         self.best_tau = roll
                         self.save_best(gidx, roll)
+                        if runlog is not None:
+                            runlog.checkpoint(step=gidx, kind="best",
+                                              rolling_tau=roll)
 
                 # replay: the only weight update (`AdHoc_train.py:187`)
                 loss = float("nan")
                 if self.mem_count >= cfg.batch:
-                    self.key, k = jax.random.split(self.key)
-                    params, self.opt_state, loss_dev = self._replay(
-                        self.memory, self.variables["params"], self.opt_state, key=k
-                    )
-                    self.variables = {"params": params}
-                    loss = float(loss_dev)
+                    with span("train/replay", block=True):
+                        self.key, k = jax.random.split(self.key)
+                        params, self.opt_state, loss_dev = self._replay(
+                            self.memory, self.variables["params"],
+                            self.opt_state, key=k
+                        )
+                        self.variables = {"params": params}
+                        loss = float(loss_dev)
                     self.replay_losses.append(loss)
                 losses.append(loss)
 
                 if np.isfinite(loss):
                     self.save(gidx)
+                    if runlog is not None:
+                        runlog.checkpoint(step=gidx, kind="latest")
                     explore = float(np.clip(explore * cfg.explore_decay, 0.0, 1.0))
                     if verbose:
                         print(f"{gidx} Loss: {np.nanmean(losses):.2f}, "
@@ -656,10 +674,28 @@ class Trainer(_Harness):
                         tb.log_scalar("explore", explore, gidx)
                         tb.log_scalar("mse_loss", float(jnp.nanmean(loss_m)), gidx)
                     losses = []
+                    # every program in the trainer's steady loop (train +
+                    # eval + metrics + replay) has now compiled at least
+                    # once: any later retrace is a perf bug, counted as
+                    # jax_unexpected_retraces_total and flagged by mho-obs
+                    if runlog is not None and not jaxhooks.is_steady():
+                        jaxhooks.mark_steady()
+                if runlog is not None:
+                    runlog.step(
+                        epoch=epoch, gidx=gidx, fid=int(fid),
+                        wall_s=round(wall, 6), build_s=round(next_build_s, 6),
+                        runtime=round(runtime, 6),
+                        loss=(loss if np.isfinite(loss) else None),
+                        explore=round(explore, 6),
+                    )
                 gidx += 1
                 train_csv.flush(rows)
                 pf.raise_deferred()
+            if runlog is not None:
+                runlog.emit("epoch", epoch=epoch, files=len(order),
+                            gidx=gidx)
         tb.flush()
+        obs.finish_run(runlog)
         return csv_path
 
 
@@ -696,14 +732,15 @@ class Evaluator(_Harness):
         the same seed.  Returns ((rec, inst, jobsets, counts), seconds)."""
         cfg = self.cfg
         t0 = time.time()
-        rec = self.data.records[fid]
-        frng = self._file_rng(fid)
-        inst = self.data.instance(fid, frng)
-        jobsets, counts = sample_jobsets(
-            rec, self.data.pad_of(fid), cfg.num_instances, frng,
-            cfg.arrival_scale, ul=cfg.ul_data, dl=cfg.dl_data,
-            dtype=cfg.jnp_dtype,
-        )
+        with span("eval/build"):
+            rec = self.data.records[fid]
+            frng = self._file_rng(fid)
+            inst = self.data.instance(fid, frng)
+            jobsets, counts = sample_jobsets(
+                rec, self.data.pad_of(fid), cfg.num_instances, frng,
+                cfg.arrival_scale, ul=cfg.ul_data, dl=cfg.dl_data,
+                dtype=cfg.jnp_dtype,
+            )
         return (rec, inst, jobsets, counts), time.time() - t0
 
     def run(self, files_limit: Optional[int] = None, out_dir: Optional[str] = None,
@@ -728,6 +765,11 @@ class Evaluator(_Harness):
         )
         n_files = min(len(self.data), files_limit or len(self.data))
         write_csv = self.is_host0 or cfg.csv_write_all_hosts
+        # JSONL run log (cfg.obs_log).  The Evaluator never declares steady
+        # state: its pad buckets make a fresh compile at each first-of-bucket
+        # file EXPECTED, so only the Trainer/serve loops count unexpected
+        # retraces; the per-phase retrace counters still attribute every one
+        runlog = obs.start_run(cfg, role="eval") if write_csv else None
 
         def flush(rows):
             # file-DP path: rows back-fill out of order -> full rewrite
@@ -737,7 +779,7 @@ class Evaluator(_Harness):
                 )
 
         if file_ids is None and self.eval_chunk > 1:
-            self._run_files_dp(n_files, verbose, flush)
+            self._run_files_dp(n_files, verbose, flush, runlog=runlog)
         else:
             # file_ids composes with files_limit: ids outside the (possibly
             # limited) file range are dropped, mirroring the sequential
@@ -770,11 +812,12 @@ class Evaluator(_Harness):
             for i, fid in enumerate(fids):
                 rec, inst, jobsets, counts = pf.current()
                 t0 = time.time()
-                bl, loc, gnn = self._eval_methods(
-                    self.variables, inst, jobsets, self._file_keys(fid)
-                )
-                next_build_s = pf.prefetch_next()
-                jax.block_until_ready(gnn)
+                with span("eval/step"):
+                    bl, loc, gnn = self._eval_methods(
+                        self.variables, inst, jobsets, self._file_keys(fid)
+                    )
+                    next_build_s = pf.prefetch_next()
+                    jax.block_until_ready(gnn)
                 wall = time.time() - t0
                 runtime = max(wall - next_build_s, 0.0) / (3 * cfg.num_instances)
                 metrics = _method_metrics(
@@ -786,11 +829,16 @@ class Evaluator(_Harness):
                 if verbose and i % 50 == 0:
                     print(f"[{i + 1}/{len(fids)}] {rec.filename} "
                           f"({wall:.3f}s for {3 * cfg.num_instances} evals)")
+                if runlog is not None:
+                    runlog.step(fid=fid, wall_s=round(wall, 6),
+                                build_s=round(next_build_s, 6),
+                                runtime=round(runtime, 6))
                 eval_csv.flush(rows)
                 pf.raise_deferred()
+        obs.finish_run(runlog)
         return csv_path
 
-    def _run_files_dp(self, n_files: int, verbose: bool, flush):
+    def _run_files_dp(self, n_files: int, verbose: bool, flush, runlog=None):
         """Batch whole files into one device program: each chunk stacks
         `eval_chunk` same-bucket files (same pad shape) — `file_batch` per
         device, vmapped — sharded over the 'data' mesh axis.  The last
@@ -842,11 +890,12 @@ class Evaluator(_Harness):
             padded = list(chunk) + [chunk[-1]] * (self.eval_chunk - real)
             keys = jnp.stack([self._file_keys(f) for f in padded])
             t0 = time.time()
-            bl, loc, gnn = self._eval_files_dp(
-                self.variables, binst, bjobs, keys
-            )
-            next_build_s = pf.prefetch_next()
-            jax.block_until_ready(gnn)
+            with span("eval/step"):
+                bl, loc, gnn = self._eval_files_dp(
+                    self.variables, binst, bjobs, keys
+                )
+                next_build_s = pf.prefetch_next()
+                jax.block_until_ready(gnn)
             wall = time.time() - t0
             # normalize by the full chunk width: pad slots run in parallel,
             # so per-eval cost is t/(3*I*eval_chunk); method compute only,
@@ -869,5 +918,10 @@ class Evaluator(_Harness):
                 print(f"[{done}/{n_files}] bucket {bucket} chunk of {real} "
                       f"({wall:.3f}s, chunk {self.eval_chunk} "
                       f"on {self.n_dp} devices)")
+            if runlog is not None:
+                runlog.step(bucket=bucket, files=real, done=done,
+                            wall_s=round(wall, 6),
+                            build_s=round(next_build_s, 6),
+                            runtime=round(runtime, 6))
             flush([r for f in sorted(rows_by_fid) for r in rows_by_fid[f]])
             pf.raise_deferred()

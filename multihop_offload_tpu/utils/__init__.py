@@ -1,1 +1,1 @@
-from multihop_offload_tpu.utils.profiling import phase_timer, trace  # noqa: F401
+from multihop_offload_tpu.obs.spans import phase_timer, span, trace  # noqa: F401

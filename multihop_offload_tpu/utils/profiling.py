@@ -1,56 +1,19 @@
-"""Tracing/profiling utilities.
+"""DEPRECATED shim — the phase timers moved to `multihop_offload_tpu.obs`.
 
-The reference's only observability is wall-clock spans written into the
-`runtime` CSV column (SURVEY.md §5.1).  Here: named phase timers with
-aggregate stats, and a `jax.profiler` trace context for TensorBoard-viewable
-device profiles.
+The old implementation accumulated spans in a bare module-global
+defaultdict, mutated from both the serve tick loop and the main thread
+with no lock.  `obs.spans` now owns the implementation: spans aggregate
+into the lock-guarded shared metric registry (`obs.registry`), nest with
+trace ids, and bridge into device profiles via
+`jax.profiler.TraceAnnotation`.  These re-exports keep existing call sites
+working; `phase_stats()` additionally reports min_s/max_s now.
 """
 
 from __future__ import annotations
 
-import contextlib
-import time
-from collections import defaultdict
-from typing import Dict, Iterator
-
-import jax
-
-_PHASES: Dict[str, list] = defaultdict(list)
-
-
-@contextlib.contextmanager
-def phase_timer(name: str, block: bool = False) -> Iterator[None]:
-    """Accumulate wall-clock spans per phase; `block=True` waits for device
-    work so the span covers execution, not just dispatch."""
-    t0 = time.perf_counter()
-    try:
-        yield
-    finally:
-        if block:
-            jax.effects_barrier()
-        _PHASES[name].append(time.perf_counter() - t0)
-
-
-def phase_stats() -> Dict[str, dict]:
-    out = {}
-    for name, spans in _PHASES.items():
-        out[name] = {
-            "count": len(spans),
-            "total_s": sum(spans),
-            "mean_s": sum(spans) / len(spans),
-        }
-    return out
-
-
-def reset_phases() -> None:
-    _PHASES.clear()
-
-
-@contextlib.contextmanager
-def trace(logdir: str) -> Iterator[None]:
-    """Device profile trace (view with TensorBoard's profile plugin)."""
-    jax.profiler.start_trace(logdir)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
+from multihop_offload_tpu.obs.spans import (  # noqa: F401
+    phase_stats,
+    phase_timer,
+    reset_phases,
+    trace,
+)

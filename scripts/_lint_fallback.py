@@ -1,0 +1,139 @@
+"""AST lint fallback for containers without ruff (see scripts/lint.sh).
+
+Approximates the ruff rule classes pyproject.toml selects:
+
+  E9   syntax / indentation errors (via `ast.parse`)
+  F401 unused imports (module scope, honoring `# noqa`, `__init__.py`
+       re-export hubs, and names listed in `__all__`)
+  F811 redefinition of an imported name by a later import
+  F841 locals assigned by a bare `name = ...` and never read are NOT
+       checked (too alias-happy without scope analysis) — ruff covers it
+
+Zero third-party imports, stdlib-only, so the gate runs anywhere the repo
+does.  Exit status: 0 clean, 1 findings, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+
+def _py_files(roots):
+    for root in roots:
+        if os.path.isfile(root):
+            if root.endswith(".py"):
+                yield root
+            continue
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", ".git", ".ruff_cache")]
+            for fn in filenames:
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def _noqa_lines(src: str):
+    return {i for i, line in enumerate(src.splitlines(), 1)
+            if "# noqa" in line}
+
+
+class _ImportVisitor(ast.NodeVisitor):
+    """Collect module-scope imported names and every referenced name."""
+
+    def __init__(self):
+        self.imports = {}   # name -> (lineno, display)
+        self.used = set()
+        self.redefs = []    # (lineno, name)
+
+    def _add(self, name: str, lineno: int, display: str):
+        if name == "*":
+            return
+        if name in self.imports:
+            self.redefs.append((lineno, name))
+        self.imports[name] = (lineno, display)
+
+    def visit_Import(self, node):
+        for a in node.names:
+            bind = a.asname or a.name.split(".")[0]
+            self._add(bind, node.lineno, a.name)
+
+    def visit_ImportFrom(self, node):
+        if node.module == "__future__":
+            return
+        for a in node.names:
+            bind = a.asname or a.name
+            self._add(bind, node.lineno, f"{node.module}.{a.name}")
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Load):
+            self.used.add(node.id)
+
+    def visit_Attribute(self, node):
+        self.generic_visit(node)
+
+
+def check_file(path: str):
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [(e.lineno or 0, f"E999 syntax error: {e.msg}")]
+    findings = []
+    noqa = _noqa_lines(src)
+    is_init = os.path.basename(path) == "__init__.py"
+    v = _ImportVisitor()
+    # module-scope imports only: function-local imports are the repo's lazy
+    # jax-import idiom and are near-always used
+    for node in tree.body:
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            v.visit(node)
+    v.used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            v.used.add(node.id)
+    exported = set()
+    for node in tree.body:
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "__all__"
+                        for t in node.targets)
+                and isinstance(node.value, (ast.List, ast.Tuple))):
+            exported = {e.value for e in node.value.elts
+                        if isinstance(e, ast.Constant)}
+    # names referenced inside docstring-driven doctests etc. are not seen;
+    # accept string-literal mentions as use (cheap, kills false positives)
+    literal_text = " ".join(
+        n.value for n in ast.walk(tree)
+        if isinstance(n, ast.Constant) and isinstance(n.value, str)
+    )
+    for name, (lineno, display) in v.imports.items():
+        if is_init or lineno in noqa or name in exported:
+            continue
+        if name in v.used or name in literal_text.split():
+            continue
+        if name.startswith("_"):
+            continue
+        findings.append((lineno, f"F401 unused import '{display}' as '{name}'"))
+    for lineno, name in v.redefs:
+        if lineno not in noqa:
+            findings.append((lineno, f"F811 import redefines '{name}'"))
+    return findings
+
+
+def main(argv):
+    roots = argv or ["multihop_offload_tpu"]
+    total = 0
+    for path in sorted(_py_files(roots)):
+        for lineno, msg in sorted(check_file(path)):
+            print(f"{path}:{lineno}: {msg}")
+            total += 1
+    if total:
+        print(f"{total} finding(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

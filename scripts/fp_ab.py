@@ -7,6 +7,17 @@ retry harness, so a wedged chip degrades to a labeled CPU fallback rather
 than a hang; the artifact keeps each run's `platform` and `fp_path` so a
 mixed-platform A/B is self-evident (and discarded).
 
+Beyond the default-pad run (padded L=256, the production shape), the script
+also measures the **L=384/512 rungs**: the same in-step A/B with
+BENCH_PAD_L forcing the link pad, xla vs pallas legs (auto stops at the
+measured win, so the kernel must be forced to get a reading above it).
+These rungs are what places `_AUTO_FP_MAX_L` (ops/fixed_point.py) — the
+microbench ladder alone sits on the tunnel's dispatch floor and mis-ranks
+them (ADVICE r5).  Rungs are TPU-only: off-TPU both legs lower to the XLA
+scan and there is nothing to compare, so they are skipped and any committed
+TPU measurement in the existing artifact is preserved, never overwritten by
+a run that could not measure.
+
 Usage: python scripts/fp_ab.py
 """
 
@@ -20,12 +31,17 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "benchmarks", "fp_ab.json")
 
+# forced-pad rungs above the production L=256; xla-vs-pallas in-step A/B
+RUNG_PAD_LS = (384, 512)
 
-def run_bench(fp_impl: str):
+
+def run_bench(fp_impl: str, pad_l: int = 0):
     sys.path.insert(0, REPO)
     from multihop_offload_tpu.utils.subproc import last_json_line
 
     env = dict(os.environ, BENCH_FP_IMPL=fp_impl)
+    if pad_l:
+        env["BENCH_PAD_L"] = str(pad_l)
     res = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],
         env=env, capture_output=True, text=True, cwd=REPO,
@@ -37,7 +53,40 @@ def run_bench(fp_impl: str):
             + " | ".join((res.stderr or res.stdout).strip().splitlines()[-3:])}
 
 
+def _load_existing() -> dict:
+    try:
+        with open(OUT) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def measure_rung(pad_l: int) -> dict:
+    """One forced-pad in-step A/B: BENCH_FP_IMPL=xla vs =pallas at
+    BENCH_PAD_L=pad_l.  `pallas` (not `auto`) because auto resolves to the
+    XLA scan above _AUTO_FP_MAX_L — the rung exists to test whether that
+    cutoff should move."""
+    xla = run_bench("xla", pad_l=pad_l)
+    pal = run_bench("pallas", pad_l=pad_l)
+    rec = {"pad_l": pad_l, "xla": xla, "pallas": pal}
+    vx, vp = xla.get("value"), pal.get("value")
+    same_platform = xla.get("platform") == pal.get("platform")
+    distinct = (pal.get("fp_path") == "pallas"
+                and xla.get("fp_path") == "xla")
+    if vx and vp and same_platform and distinct:
+        rec["pallas_over_xla"] = round(vp / vx, 4)
+        rec["platform"] = xla["platform"]
+    else:
+        rec["pallas_over_xla"] = None
+        rec["note"] = ("ratio withheld: " +
+                       ("platform mismatch or failed leg" if not same_platform
+                        or not (vx and vp)
+                        else "both legs executed the XLA scan (off-TPU)"))
+    return rec
+
+
 def main() -> int:
+    old = _load_existing()
     xla = run_bench("xla")
     auto = run_bench("auto")
     rec = {
@@ -57,6 +106,13 @@ def main() -> int:
     if vx and va and same_platform and distinct_paths:
         rec["auto_over_xla"] = round(va / vx, 4)
         rec["platform"] = xla["platform"]
+    elif old.get("auto_over_xla") is not None:
+        # this run could not measure (off-TPU / failed leg) — keep the
+        # committed on-chip record rather than clobbering it
+        for k in ("xla", "auto", "auto_over_xla", "platform"):
+            if k in old:
+                rec[k] = old[k]
+        rec["note"] = "default-pad legs preserved from the committed TPU run"
     else:
         rec["auto_over_xla"] = None
         rec["note"] = ("ratio withheld: " +
@@ -64,12 +120,50 @@ def main() -> int:
                         or not (vx and va)
                         else "both legs executed the XLA scan (off-TPU or "
                              "beyond the kernel's measured-win shapes)"))
+
+    # ---- forced-pad rungs (TPU only) --------------------------------------
+    on_tpu = xla.get("platform") == "tpu" and auto.get("platform") == "tpu"
+    old_rungs = old.get("rungs", {})
+    rungs = {}
+    for pad_l in RUNG_PAD_LS:
+        key = str(pad_l)
+        if on_tpu:
+            fresh = measure_rung(pad_l)
+            kept = old_rungs.get(key)
+            if (fresh.get("pallas_over_xla") is None and kept
+                    and kept.get("pallas_over_xla") is not None):
+                fresh = dict(kept,
+                             note="preserved committed TPU rung; this run "
+                                  "could not measure")
+            rungs[key] = fresh
+        else:
+            kept = old_rungs.get(key)
+            if kept and kept.get("pallas_over_xla") is not None:
+                rungs[key] = kept
+            else:
+                rungs[key] = {
+                    "pad_l": pad_l,
+                    "pallas_over_xla": None,
+                    "note": "skipped off-TPU: both legs would execute the "
+                            "XLA scan; run scripts/fp_ab.py on the chip to "
+                            "fill this rung",
+                }
+    rec["rungs"] = rungs
+    rec["rungs_note"] = (
+        "in-step A/B at BENCH_PAD_L-forced link pads, xla vs pallas legs; "
+        "the evidence that places _AUTO_FP_MAX_L (ops/fixed_point.py). A "
+        "null pallas_over_xla means the rung has no on-chip measurement yet."
+    )
     os.makedirs(os.path.dirname(OUT), exist_ok=True)
     with open(OUT, "w") as f:
         json.dump(rec, f, indent=1)
         f.write("\n")
-    print(json.dumps({k: rec.get(k) for k in
-                      ("auto_over_xla", "platform", "note")}))
+    print(json.dumps({
+        "auto_over_xla": rec.get("auto_over_xla"),
+        "platform": rec.get("platform"),
+        "note": rec.get("note"),
+        "rungs": {k: v.get("pallas_over_xla") for k, v in rungs.items()},
+    }))
     print(f"wrote {OUT}")
     return 0
 

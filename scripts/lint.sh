@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Repo lint gate — run alongside the tier-1 pytest recipe (ROADMAP.md).
+#
+#   bash scripts/lint.sh
+#
+# Prefers ruff (configured in pyproject.toml [tool.ruff]); when ruff is not
+# installed (this container ships none of ruff/flake8/pyflakes), falls back
+# to scripts/_lint_fallback.py, an AST checker approximating the same rule
+# classes (syntax errors, unused imports, undefined-name smells).  Exit 0 =
+# clean.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+if command -v ruff >/dev/null 2>&1; then
+    exec ruff check .
+elif python -c "import ruff" >/dev/null 2>&1; then
+    exec python -m ruff check .
+else
+    echo "lint.sh: ruff not installed; using AST fallback checker" >&2
+    exec python scripts/_lint_fallback.py \
+        multihop_offload_tpu tests scripts bench.py
+fi

@@ -25,8 +25,6 @@ import os
 import sys
 import time
 
-import numpy as np
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from multihop_offload_tpu.utils.platform import apply_platform_env  # noqa: E402

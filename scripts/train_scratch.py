@@ -29,7 +29,6 @@ Usage examples:
 from __future__ import annotations
 
 import argparse
-import dataclasses
 import json
 import os
 import sys

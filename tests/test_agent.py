@@ -3,7 +3,6 @@
 import numpy as np
 import jax
 import jax.numpy as jnp
-import optax
 import pytest
 
 from multihop_offload_tpu.config import Config

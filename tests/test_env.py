@@ -14,8 +14,6 @@ from multihop_offload_tpu.env import (
     interference_fixed_point,
     local_policy,
     next_hop_table,
-    offload_decide,
-    run_empirical,
     trace_routes,
     weight_matrix_from_link_delays,
 )

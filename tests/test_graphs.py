@@ -1,7 +1,5 @@
 """Graph layer: topology arrays vs NetworkX oracles, padding, .mat IO."""
 
-import os
-
 import networkx as nx
 import numpy as np
 import pytest

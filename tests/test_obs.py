@@ -1,0 +1,288 @@
+"""obs/ subsystem: registry, spans, run log, retrace hooks, report, CLI."""
+
+import json
+import threading
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from multihop_offload_tpu.obs import events as obs_events
+from multihop_offload_tpu.obs import jaxhooks
+from multihop_offload_tpu.obs.events import RunLog, read_events, run_manifest
+from multihop_offload_tpu.obs.registry import MetricRegistry, registry
+from multihop_offload_tpu.obs.spans import (
+    current_phase,
+    phase_stats,
+    reset_phases,
+    span,
+)
+
+
+# ---- registry ---------------------------------------------------------------
+
+def test_registry_counter_gauge_histogram_basics():
+    reg = MetricRegistry()
+    c = reg.counter("c_total", "a counter")
+    c.inc()
+    c.inc(2.0, route="a")
+    assert c.value() == 1.0
+    assert c.value(route="a") == 2.0
+    assert c.total() == 3.0
+    with pytest.raises(ValueError):
+        c.inc(-1.0)
+
+    g = reg.gauge("g", "a gauge")
+    g.set(5.0)
+    g.inc(1.5)
+    assert g.value() == 6.5
+    assert g.value(missing="x") is None
+
+    h = reg.histogram("h_seconds", "a histogram")
+    for v in (0.002, 0.002, 0.3):
+        h.observe(v)
+    s = h.stats()
+    assert s["count"] == 3
+    assert s["min_s"] == pytest.approx(0.002)
+    assert s["max_s"] == pytest.approx(0.3)
+    assert s["total_s"] == pytest.approx(0.304)
+
+    # kind clash fails loudly instead of silently aliasing
+    with pytest.raises(TypeError):
+        reg.gauge("c_total")
+
+
+def test_registry_prometheus_exposition_golden():
+    reg = MetricRegistry()
+    reg.counter("req_total", "requests").inc(3, route="a")
+    reg.counter("req_total").inc(1, route="b")
+    reg.gauge("depth").set(7)
+    h = reg.histogram("lat_seconds", "latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(2.0)
+    assert reg.prometheus_text() == (
+        "# TYPE depth gauge\n"
+        "depth 7\n"
+        "# HELP lat_seconds latency\n"
+        "# TYPE lat_seconds histogram\n"
+        'lat_seconds_bucket{le="0.1"} 1\n'
+        'lat_seconds_bucket{le="1.0"} 2\n'
+        'lat_seconds_bucket{le="+Inf"} 3\n'
+        "lat_seconds_sum 2.55\n"
+        "lat_seconds_count 3\n"
+        "# HELP req_total requests\n"
+        "# TYPE req_total counter\n"
+        'req_total{route="a"} 3\n'
+        'req_total{route="b"} 1\n'
+    )
+
+
+def test_registry_concurrent_increments_not_lost():
+    reg = MetricRegistry()
+    n, threads = 2000, 2
+
+    def worker():
+        c = reg.counter("shared_total")
+        h = reg.histogram("shared_seconds")
+        for _ in range(n):
+            c.inc()
+            h.observe(0.01)
+
+    ts = [threading.Thread(target=worker) for _ in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert reg.counter("shared_total").total() == n * threads
+    assert reg.histogram("shared_seconds").stats()["count"] == n * threads
+
+
+# ---- spans ------------------------------------------------------------------
+
+def test_span_nesting_ids_and_phase_stats():
+    reset_phases()
+    assert current_phase() == ""
+    with span("outer") as outer:
+        assert current_phase() == "outer"
+        with span("outer/inner") as inner:
+            assert current_phase() == "outer/inner"
+            assert inner["parent_id"] == outer["span_id"]
+            assert inner["trace_id"] == outer["trace_id"]
+        assert current_phase() == "outer"
+    assert current_phase() == ""
+    s = phase_stats()
+    assert s["outer"]["count"] == 1 and s["outer/inner"]["count"] == 1
+    for rec in s.values():
+        assert rec["min_s"] <= rec["mean_s"] <= rec["max_s"]
+        assert rec["total_s"] >= 0
+    reset_phases()
+    assert phase_stats() == {}
+
+
+def test_legacy_profiling_shim_still_works():
+    # utils.profiling deprecated into obs.spans; old call sites keep working
+    from multihop_offload_tpu.utils.profiling import (
+        phase_stats as ps,
+        phase_timer,
+        reset_phases as rp,
+    )
+
+    rp()
+    with phase_timer("legacy"):
+        pass
+    assert ps()["legacy"]["count"] == 1
+    rp()
+
+
+# ---- run log (JSONL) --------------------------------------------------------
+
+def test_runlog_jsonl_schema_roundtrip(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    log = RunLog(path, manifest=run_manifest(role="test"))
+    log.step(epoch=0, fid=3, wall_s=0.5, loss=1.25)
+    log.tick(n=1, served=4, queue_depth=2)
+    log.checkpoint(step=10, kind="best")
+    log.summary(phases={"train/step": {"count": 1, "total_s": 0.5}},
+                metrics={})
+    log.close()
+
+    rows = list(read_events(path))
+    assert [r["event"] for r in rows] == [
+        "manifest", "step", "tick", "checkpoint", "summary",
+    ]
+    man = rows[0]
+    assert man["role"] == "test" and man["schema_version"] == 1
+    assert "jax_version" in man and "platform" in man
+    assert rows[1]["fid"] == 3 and rows[1]["loss"] == 1.25
+    assert rows[2]["queue_depth"] == 2
+    assert rows[4]["phases"]["train/step"]["count"] == 1
+    assert all("ts" in r for r in rows)
+
+
+def test_read_events_tolerates_truncated_tail(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({"event": "manifest", "ts": 0}) + "\n")
+        f.write('{"event": "step", "truncat')  # crashed mid-write
+    rows = list(read_events(path))
+    assert len(rows) == 1 and rows[0]["event"] == "manifest"
+
+
+def test_span_emit_writes_event_row(tmp_path):
+    log = RunLog(str(tmp_path / "run.jsonl"))
+    obs_events.set_run_log(log)
+    try:
+        with span("coarse", emit=True, detail="x"):
+            pass
+    finally:
+        obs_events.set_run_log(None)
+        log.close()
+    rows = list(read_events(log.path))
+    spans = [r for r in rows if r["event"] == "span"]
+    assert len(spans) == 1
+    assert spans[0]["name"] == "coarse" and spans[0]["detail"] == "x"
+    assert spans[0]["duration_s"] >= 0
+
+
+# ---- jax hooks: retrace / compile tracking ----------------------------------
+
+def test_retrace_counter_catches_injected_shape_change():
+    jaxhooks.install()
+    jaxhooks.clear_steady()
+
+    @jax.jit
+    def f(x):
+        return (x * 2.0).sum()
+
+    reg = registry()
+
+    with span("obs-test/warm"):
+        f(jnp.zeros(8)).block_until_ready()
+        f(jnp.ones(8)).block_until_ready()  # cache hit: no new trace
+    warm = reg.counter("jax_retraces_total").value(phase="obs-test/warm")
+    assert warm >= 1  # first call traced (>=1: nested pjit may multi-fire)
+
+    jaxhooks.mark_steady()
+    try:
+        before = jaxhooks.unexpected_retraces()
+        with span("obs-test/steady"):
+            f(jnp.zeros(8)).block_until_ready()  # same shape: still cached
+        assert jaxhooks.unexpected_retraces() == before
+
+        with span("obs-test/leak"):
+            f(jnp.zeros(16)).block_until_ready()  # injected shape change
+        assert jaxhooks.unexpected_retraces() > before
+        assert reg.counter("jax_unexpected_retraces_total").value(
+            phase="obs-test/leak") >= 1
+    finally:
+        jaxhooks.clear_steady()
+
+
+# ---- report + CLI -----------------------------------------------------------
+
+def test_report_renders_phases_and_retraces(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    log = RunLog(path, manifest=run_manifest(role="train"))
+    log.step(epoch=0, fid=0, wall_s=0.2)
+    log.summary(
+        phases={
+            "train/build": {"count": 2, "total_s": 0.5, "mean_s": 0.25,
+                            "min_s": 0.2, "max_s": 0.3},
+            "train/step": {"count": 2, "total_s": 1.5, "mean_s": 0.75,
+                           "min_s": 0.7, "max_s": 0.8},
+        },
+        metrics={
+            "jax_retraces_total": {
+                "kind": "counter", "help": "",
+                "series": {'{phase="train/step"}': 3.0},
+            },
+            "jax_unexpected_retraces_total": {
+                "kind": "counter", "help": "",
+                "series": {'{phase="train/step"}': 1.0},
+            },
+        },
+    )
+    log.close()
+
+    from multihop_offload_tpu.obs.report import load_run, render_report
+
+    run = load_run(path)
+    assert run["manifest"]["role"] == "train"
+    text = render_report(path)
+    assert "train/build" in text and "train/step" in text
+    assert "input-wait" in text
+    assert "unexpected" in text and "PERF BUG" in text
+
+    from multihop_offload_tpu.cli.obs import main as obs_main
+
+    assert obs_main([path]) == 0
+    assert obs_main([path, "--json"]) == 0
+
+
+def test_start_finish_run_wiring(tmp_path):
+    import types
+
+    from multihop_offload_tpu import obs
+
+    assert obs.start_run(types.SimpleNamespace(obs_log=""), role="x") is None
+
+    cfg = types.SimpleNamespace(
+        obs_log=str(tmp_path / "run.jsonl"),
+        obs_prom=str(tmp_path / "metrics.prom"),
+    )
+    log = obs.start_run(cfg, role="smoke")
+    assert obs_events.get_run_log() is log
+    registry().counter("obs_smoke_total").inc()
+    with span("smoke/phase"):
+        pass
+    obs.finish_run(log)
+    assert obs_events.get_run_log() is None
+
+    rows = list(read_events(cfg.obs_log))
+    assert rows[0]["event"] == "manifest" and rows[0]["role"] == "smoke"
+    assert rows[-1]["event"] == "summary"
+    assert "smoke/phase" in rows[-1]["phases"]
+    assert "obs_smoke_total" in rows[-1]["metrics"]
+    prom = open(cfg.obs_prom).read()
+    assert "obs_smoke_total 1" in prom

@@ -20,11 +20,7 @@ from multihop_offload_tpu.graphs.instance import (
     compute_hop_matrix,
 )
 from multihop_offload_tpu.serve.bucketing import pack_bucket
-from multihop_offload_tpu.serve.workload import (
-    buckets_for_pool,
-    case_pool,
-    request_stream,
-)
+from multihop_offload_tpu.serve.workload import case_pool, request_stream
 
 SIZES = [10, 16]
 
