@@ -1,0 +1,254 @@
+"""Simulation entry point (`mho-sim`) — closed-loop packet-level evaluation.
+
+    mho-sim --smoke                      # <1 min CPU self-check (tier-1 adjacent)
+    mho-sim --fidelity                   # sim-vs-analytic sweep -> benchmarks/
+    mho-sim --sim_policy=gnn --sim_util=0.7 --sim_fail_links=2
+
+Default mode simulates `sim_fleet` random scenarios with the configured
+policy in the loop (re-decided every `sim_slots` slots on empirically
+measured arrival rates, `sim_rounds` times), optionally injecting link and
+node failures at mid-horizon, and prints a JSON summary: delivery/drop/
+delay per policy plus the conservation check.  All fleet members run in
+ONE jitted program; wire `--obs_log` to get `sim/build` + `sim/scan` spans
+and the `mho_sim_*` counters in the run report (`mho-obs`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import numpy as np
+
+from multihop_offload_tpu.config import Config, build_parser
+
+
+def _make_gnn_policy(cfg: Config, pad):
+    """Build the GNN policy function; checkpoint if present, else fresh init
+    (mirrors `cli.serve` — an untrained GNN still exercises the loop)."""
+    import jax
+    import jax.numpy as jnp
+
+    from multihop_offload_tpu.models import make_model
+    from multihop_offload_tpu.sim.policies import make_policy
+    from multihop_offload_tpu.train import checkpoints as ckpt_lib
+
+    model = make_model(cfg)
+    variables = model.init(
+        jax.random.PRNGKey(cfg.seed),
+        jnp.zeros((pad.e, 4), cfg.jnp_dtype),
+        jnp.zeros((pad.e, pad.e), cfg.jnp_dtype),
+    )
+    loaded = None
+    try:
+        step = ckpt_lib.latest_step(cfg.model_dir())
+        if step is not None:
+            restored = ckpt_lib.restore_checkpoint_raw(cfg.model_dir(), step)
+            params = restored.get("params", restored) if isinstance(
+                restored, dict) else restored
+            cur = variables["params"]
+            rebuilt = jax.tree_util.tree_map(
+                lambda t, r: jnp.asarray(r, jnp.asarray(t).dtype), cur,
+                jax.tree_util.tree_map(np.asarray, params),
+            )
+            variables = {"params": rebuilt}
+            loaded = step
+    except Exception as e:  # structure mismatch / no orbax tree: fresh init
+        print(f"checkpoint load failed ({e}); using fresh init")
+    print("sim gnn policy: "
+          + (f"checkpoint step {loaded}" if loaded is not None
+             else "fresh-init weights"))
+    return make_policy("gnn", model=model, variables=variables)
+
+
+def run_scenarios(cfg: Config, steady: bool = True) -> dict:
+    """Default mode: fleet simulation under the configured policy.
+
+    `steady=False` skips the steady-state declaration — used when the caller
+    will compile further programs afterwards (e.g. the multi-policy smoke)."""
+    import jax
+    import jax.numpy as jnp
+
+    from multihop_offload_tpu.env.policies import baseline_policy
+    from multihop_offload_tpu.graphs import generators
+    from multihop_offload_tpu.graphs.instance import PadSpec, stack_instances
+    from multihop_offload_tpu.graphs.topology import build_topology
+    from multihop_offload_tpu.sim.fidelity import make_case, scale_to_util
+    from multihop_offload_tpu.sim.policies import make_policy
+    from multihop_offload_tpu.sim.runner import FleetSim
+    from multihop_offload_tpu.sim.state import build_sim_params, spec_for
+
+    fleet, n_nodes = cfg.sim_fleet, cfg.sim_nodes
+    topos = [
+        build_topology(
+            generators.barabasi_albert(n_nodes, seed=cfg.seed + 100 * i)[0]
+        )
+        for i in range(fleet)
+    ]
+    pad = PadSpec(
+        n=-(-n_nodes // cfg.round_to) * cfg.round_to,
+        l=-(-max(t.num_links for t in topos) // cfg.round_to) * cfg.round_to,
+        s=cfg.round_to,
+        j=max(cfg.sim_jobs, cfg.round_to),
+    )
+    keys = jax.random.split(jax.random.PRNGKey(cfg.seed), fleet)
+    bp = jax.jit(baseline_policy)
+    total_slots = cfg.sim_rounds * cfg.sim_slots
+    fail_slot = total_slots // 2
+    rng = np.random.default_rng(cfg.seed)
+
+    cases, params_list = [], []
+    for i in range(fleet):
+        inst, jobs = make_case(
+            cfg.seed + 100 * i, topos[i], pad, cfg.sim_jobs
+        )
+        jobs, _ = scale_to_util(inst, jobs, keys[i], cfg.sim_util,
+                                policy_fn=bp)
+        fail_link = np.full((pad.l,), -1, np.int32)
+        fail_node = np.full((pad.n,), -1, np.int32)
+        if cfg.sim_fail_links > 0:
+            real = np.arange(topos[i].num_links)
+            kill = rng.choice(real, size=min(cfg.sim_fail_links, real.size),
+                              replace=False)
+            fail_link[kill] = fail_slot
+        if cfg.sim_fail_nodes > 0:
+            roles_srv = np.asarray(inst.servers[np.asarray(inst.server_mask)])
+            cand = np.setdiff1d(np.arange(n_nodes),
+                                np.concatenate([roles_srv,
+                                                np.asarray(jobs.src)]))
+            if cand.size:
+                kill = rng.choice(
+                    cand, size=min(cfg.sim_fail_nodes, cand.size),
+                    replace=False)
+                fail_node[kill] = fail_slot
+        cases.append((inst, jobs))
+        params_list.append(build_sim_params(
+            inst, jobs, margin=cfg.sim_margin,
+            fail_link_slot=fail_link, fail_node_slot=fail_node,
+        ))
+
+    if cfg.sim_policy == "gnn":
+        policy = _make_gnn_policy(cfg, pad)
+    else:
+        policy = make_policy(cfg.sim_policy)
+
+    inst0, jobs0 = cases[0]
+    spec = spec_for(inst0, jobs0, cap=cfg.sim_cap)
+    sim = FleetSim(spec, policy, rounds=cfg.sim_rounds,
+                   slots_per_round=cfg.sim_slots)
+    run = sim.run(
+        stack_instances([c[0] for c in cases]),
+        stack_instances([c[1] for c in cases]),
+        stack_instances(params_list),
+        keys,
+    )
+    if steady:
+        sim.mark_steady()
+
+    st = jax.tree_util.tree_map(np.asarray, run.state)
+    j = spec.num_jobs
+    generated = st.generated.sum(axis=1)
+    delivered = st.delivered.sum(axis=1)
+    dropped = st.dropped.sum(axis=1)
+    in_flight = st.count[:, :-1].sum(axis=1)
+    gap = generated - delivered - dropped - in_flight
+    dt = [float(p.dt) for p in params_list]
+    with np.errstate(divide="ignore", invalid="ignore"):
+        mean_delay = np.where(
+            st.delivered > 0, st.delay_sum / np.maximum(st.delivered, 1), np.nan
+        ) * np.asarray(dt)[:, None]
+    summary = {
+        "policy": cfg.sim_policy,
+        "fleet": fleet,
+        "slots": total_slots,
+        "rounds": cfg.sim_rounds,
+        "util_target": cfg.sim_util,
+        "fail_links": cfg.sim_fail_links,
+        "fail_nodes": cfg.sim_fail_nodes,
+        "fail_slot": fail_slot if
+        (cfg.sim_fail_links or cfg.sim_fail_nodes) else None,
+        "generated": int(generated.sum()),
+        "delivered": int(delivered.sum()),
+        "dropped": int(dropped.sum()),
+        "in_flight": int(in_flight.sum()),
+        "conservation_ok": bool((gap == 0).all()),
+        "delivery_ratio": float(delivered.sum() / max(generated.sum(), 1)),
+        "mean_packet_delay_ul": float(np.nanmean(mean_delay[:, :j]))
+        if np.isfinite(mean_delay[:, :j]).any() else None,
+        "mean_packet_delay_dl": float(np.nanmean(mean_delay[:, j:]))
+        if np.isfinite(mean_delay[:, j:]).any() else None,
+    }
+    return summary
+
+
+def run_smoke(cfg: Config) -> dict:
+    """Tier-1-adjacent quick check: tiny fleet, all three policies, asserts
+    conservation + zero retraces after steady.  CPU, well under a minute."""
+    smoke_cfg = dataclasses.replace(
+        cfg, sim_fleet=2, sim_nodes=8, sim_jobs=3, sim_rounds=2,
+        sim_slots=150, sim_util=0.4, sim_cap=64,
+        sim_fail_links=1, sim_fail_nodes=0,
+    )
+    results = {}
+    for pol in ("baseline", "local"):
+        s = run_scenarios(
+            dataclasses.replace(smoke_cfg, sim_policy=pol), steady=False
+        )
+        assert s["conservation_ok"], f"conservation violated under {pol}"
+        results[pol] = s
+    results["ok"] = True
+    return results
+
+
+def main(argv=None):
+    from multihop_offload_tpu import obs
+    from multihop_offload_tpu.utils.platform import apply_platform_env
+
+    p = build_parser()
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny self-check run (tier-1 adjacent, <1 min CPU)")
+    p.add_argument("--fidelity", action="store_true",
+                   help="sim-vs-analytic fidelity sweep; writes the "
+                        "benchmarks/sim_fidelity.json record")
+    ns = p.parse_args(argv)
+    mode_smoke, mode_fid = ns.smoke, ns.fidelity
+    cfg = Config(**{f.name: getattr(ns, f.name)
+                    for f in dataclasses.fields(Config)})
+
+    apply_platform_env()
+    runlog = obs.start_run(cfg, role="sim")
+    try:
+        if mode_smoke:
+            out = run_smoke(cfg)
+        elif mode_fid:
+            from multihop_offload_tpu.sim.fidelity import (
+                fidelity_sweep, write_record,
+            )
+
+            out = fidelity_sweep(
+                fleet=cfg.sim_fleet, n_nodes=cfg.sim_nodes,
+                num_jobs=cfg.sim_jobs, rounds=cfg.sim_rounds,
+                slots_per_round=cfg.sim_slots, margin=cfg.sim_margin,
+                cap=cfg.sim_cap, seed=cfg.seed,
+            )
+            path = cfg.sim_out or "benchmarks/sim_fidelity.json"
+            write_record(out, path)
+            print(f"fidelity record written to {path}")
+        else:
+            out = run_scenarios(cfg)
+            if cfg.sim_out:
+                with open(cfg.sim_out, "w") as f:
+                    json.dump(out, f, indent=1)
+                    f.write("\n")
+    finally:
+        obs.finish_run(runlog)
+    print(json.dumps(
+        out if not mode_fid else out["acceptance"], indent=2, default=str
+    ))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -135,6 +135,27 @@ class Config:
     tb_logdir: str = ""            # TensorBoard scalars ("" = disabled); the
     #                                working version of the reference's
     #                                disabled log_init/log_scalar hooks
+    # ---- simulation (sim/ subsystem; cli.sim + sim.fidelity) ---------------
+    sim_policy: str = "baseline"   # offloading policy in the loop:
+    #                                baseline | local | gnn (gnn loads the
+    #                                configured checkpoint, fresh init if none)
+    sim_fleet: int = 8             # instances simulated in one vmapped program
+    sim_nodes: int = 10            # nodes per random BA scenario graph
+    sim_jobs: int = 4              # jobs per instance
+    sim_rounds: int = 5            # policy re-decisions per run (outer scan)
+    sim_slots: int = 1000          # slots per policy round (inner scan)
+    sim_util: float = 0.5          # analytic bottleneck-utilization target the
+    #                                workload is rescaled to before simulating
+    sim_margin: float = 5.0        # slot sizing: dt = 1/(margin * max link
+    #                                rate) — larger = finer slots, less
+    #                                discretization bias, more slots per unit
+    #                                of model time
+    sim_cap: int = 128             # ring-buffer capacity per queue (overflow
+    #                                packets are dropped and counted)
+    sim_fail_links: int = 0        # random links to fail at mid-horizon
+    sim_fail_nodes: int = 0        # random non-server nodes to fail likewise
+    sim_out: str = ""              # write the run/fidelity JSON record here
+    #                                ("" = print only / default record path)
     # ---- observability (obs/ subsystem; docs/OPERATIONS.md) ----------------
     obs_log: str = ""              # structured JSONL run-log sink ("" =
     #                                disabled): manifest header + typed

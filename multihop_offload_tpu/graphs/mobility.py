@@ -28,9 +28,21 @@ def random_walk(
     max_tries: int = 1000,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Jitter `n_moving` random nodes by N(0, step_std) until the unit-disk
-    graph stays connected; returns (new_pos, new_adj)."""
+    graph stays connected; returns (new_pos, new_adj).
+
+    Degenerate inputs degrade to a no-move step rather than erroring: an
+    empty fleet, zero movers, or zero step size return the input positions
+    unchanged, and an exhausted retry budget (radius too tight for any
+    connected perturbation) falls back to the unperturbed graph when that
+    one is itself connected — a mobility trace should stall, not crash,
+    on a hard slot.  Only an input that is ALREADY disconnected raises.
+    """
     rng = rng or np.random.default_rng()
     n = pos.shape[0]
+    if n == 0:
+        return pos.copy(), np.zeros((0, 0), dtype=np.uint8)
+    if n_moving <= 0 or step_std <= 0.0:
+        return pos.copy(), unit_disk_adjacency(pos, radius)
     lo, hi = bounds if bounds is not None else (pos.min(), pos.max())
     for _ in range(max_tries):
         moving = rng.choice(n, size=min(n_moving, n), replace=False)
@@ -40,6 +52,9 @@ def random_walk(
         adj = unit_disk_adjacency(cand, radius)
         if build_topology(adj).connected:
             return cand, adj
+    adj = unit_disk_adjacency(pos, radius)
+    if build_topology(adj).connected:
+        return pos.copy(), adj
     raise RuntimeError("random_walk: no connected perturbation found")
 
 

@@ -88,7 +88,13 @@ def _conflict_extra(
     (`offloading_v3.py:193-224`), vectorized."""
     d = distance_matrix(pos, pos)
     link_dist = d[link_ends[:, 0], link_ends[:, 1]]
-    thresh = cf_radius * np.nanmedian(link_dist)
+    finite = link_dist[np.isfinite(link_dist)]
+    if finite.size == 0:
+        # linkless (or NaN-positioned) graph after a mobility step: no
+        # distance scale exists, so no physical conflicts beyond adj_lg —
+        # np.nanmedian would warn and poison `thresh` with NaN here
+        return adj_lg.copy()
+    thresh = cf_radius * np.median(finite)
     # near[l, v]: link l has an endpoint within thresh of node v
     near = (d[link_ends[:, 0], :] < thresh) | (d[link_ends[:, 1], :] < thresh)
     # links k whose some endpoint is a node near link l

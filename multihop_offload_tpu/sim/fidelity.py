@@ -1,0 +1,337 @@
+"""Sim-vs-analytic fidelity sweep (`mho-sim --fidelity`).
+
+The analytic evaluator prices every link as an interference-coupled M/M/1
+queue; the simulator realizes the same system packet by packet.  This
+harness drives both on the *same* instances, jobs and (baseline) routing
+decisions across an arrival-rate sweep and reports where they agree:
+
+- **per-link**: empirical mean channel sojourn (`q_sojourn / q_served * dt``,
+  both direction queues of a link pooled) against the analytic per-packet
+  delay ``1/(mu - lambda)`` — traffic-weighted relative error over links
+  with enough served packets;
+- **per-server**: server-queue sojourn against ``1/(bw - load)``;
+- **end-to-end**: per-stream mean packet delay against the analytic
+  route sum of unit delays.
+
+Low utilization is the regime where the M/M/1 idealization should hold
+(geometric service -> exponential in the ``dt -> 0`` limit; MWIS sharing
+-> the busyness fixed point when queues rarely collide), so the committed
+record (`benchmarks/sim_fidelity.json`) gates on utilization <= 0.5; the
+high-utilization rows are kept to *document* where queueing dynamics leave
+the analytic model, which is the point of having a simulator at all.
+
+The whole sweep runs through ONE compiled fleet program: every utilization
+reuses the same `FleetSim` (only array values change), `mark_steady` fires
+after the first segment, and the JSON records the unexpected-retrace count
+(must be 0).  Discretization note: the geometric approximation biases
+sojourn by O(arrival prob per slot); `margin` sets ``dt`` so the busiest
+link's per-slot probabilities stay small (default 5 -> <= 0.2).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from multihop_offload_tpu.env.policies import baseline_policy
+from multihop_offload_tpu.graphs import generators
+from multihop_offload_tpu.graphs.instance import (
+    PadSpec,
+    build_instance,
+    build_jobset,
+    stack_instances,
+)
+from multihop_offload_tpu.graphs.topology import build_topology, sample_link_rates
+from multihop_offload_tpu.obs import jaxhooks
+from multihop_offload_tpu.sim.policies import make_policy
+from multihop_offload_tpu.sim.runner import FleetSim
+from multihop_offload_tpu.sim.state import build_sim_params, spec_for
+
+DEFAULT_UTILS = (0.1, 0.2, 0.3, 0.4, 0.5, 0.7, 0.85)
+
+
+def make_case(seed: int, topo, pad: PadSpec, num_jobs: int,
+              num_servers: int = 2, dtype=np.float32):
+    """One random connected BA case with a mid-load workload (rates are
+    rescaled per utilization target afterwards)."""
+    rng = np.random.default_rng(seed)
+    n_nodes = topo.n
+    deg = np.asarray(topo.adj).sum(axis=1)
+    servers = np.argsort(-deg, kind="stable")[:num_servers]
+    roles = np.zeros(n_nodes, np.int32)
+    roles[servers] = 1
+    bws = np.where(roles == 1, 100.0, 8.0)
+    rates = sample_link_rates(topo, 50.0, rng=rng)
+    inst = build_instance(topo, roles, bws, rates, 1000.0, pad, dtype=dtype)
+    mobile = np.setdiff1d(np.arange(n_nodes), servers)
+    srcs = rng.choice(mobile, size=min(num_jobs, mobile.size), replace=False)
+    jrates = rng.uniform(0.5, 1.0, srcs.size)
+    jobs = build_jobset(srcs, jrates, pad_jobs=pad.j, dtype=dtype)
+    return inst, jobs
+
+
+def max_busyness(inst, jobs, outcome) -> float:
+    """Bottleneck rho over real links and loaded servers for a decision."""
+    lam = np.asarray(outcome.delays.link_lambda, np.float64)
+    mu = np.asarray(outcome.delays.link_mu, np.float64)
+    lmask = np.asarray(inst.link_mask) & (lam > 0)
+    rho_l = (lam[lmask] / mu[lmask]).max() if lmask.any() else 0.0
+    load = np.asarray(outcome.delays.server_load, np.float64)
+    bw = np.asarray(inst.proc_bws, np.float64)
+    smask = (load > 0) & (bw > 0)
+    rho_s = (load[smask] / bw[smask]).max() if smask.any() else 0.0
+    return float(max(rho_l, rho_s, 1e-9))
+
+
+def scale_to_util(inst, jobs, key, target: float, iters: int = 3,
+                  policy_fn=baseline_policy):
+    """Rescale job rates until the analytic bottleneck rho hits `target`.
+
+    The interference fixed point makes mu depend on lambda, so rho is not
+    linear in the rates; a few multiplicative corrections converge.  Pass a
+    jitted `policy_fn` when calling repeatedly — the eager path builds fresh
+    scan/while closures per call, which recompiles every time."""
+    for _ in range(iters):
+        out = policy_fn(inst, jobs, key)
+        jobs = jobs.replace(
+            rate=jobs.rate * (target / max_busyness(inst, jobs, out))
+        )
+    return jobs, policy_fn(inst, jobs, key)
+
+
+def analytic_link_delay(inst, outcome) -> np.ndarray:
+    """(L,) per-packet channel delay 1/(mu - lambda); NaN where untraversed
+    or analytically congested."""
+    lam = np.asarray(outcome.delays.link_lambda, np.float64)
+    mu = np.asarray(outcome.delays.link_mu, np.float64)
+    ok = np.asarray(inst.link_mask) & (lam > 0) & (mu > lam)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        d = np.where(ok, 1.0 / (mu - lam), np.nan)
+    return d
+
+
+def analytic_server_delay(inst, outcome) -> np.ndarray:
+    """(N,) per-packet server delay 1/(bw - load); NaN where unloaded."""
+    load = np.asarray(outcome.delays.server_load, np.float64)
+    bw = np.asarray(inst.proc_bws, np.float64)
+    ok = (load > 0) & (bw > load)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        d = np.where(ok, 1.0 / (bw - load), np.nan)
+    return d
+
+
+def empirical_queue_delays(state, spec, dt: float, min_served: int = 50):
+    """Pooled per-channel and per-server (sojourn, served) in model time."""
+    num_links, n = spec.num_links, spec.num_nodes
+    soj = np.asarray(state.q_sojourn, np.float64)
+    srv = np.asarray(state.q_served, np.float64)
+    ch_soj = soj[:num_links] + soj[num_links:2 * num_links]
+    ch_srv = srv[:num_links] + srv[num_links:2 * num_links]
+    with np.errstate(divide="ignore", invalid="ignore"):
+        link_d = np.where(ch_srv >= min_served, ch_soj / ch_srv * dt, np.nan)
+        srv_d = np.where(
+            srv[2 * num_links:2 * num_links + n] >= min_served,
+            soj[2 * num_links:2 * num_links + n]
+            / srv[2 * num_links:2 * num_links + n] * dt,
+            np.nan,
+        )
+    return link_d, srv_d
+
+
+def _weighted_err(emp: np.ndarray, ana: np.ndarray, weight: np.ndarray):
+    ok = np.isfinite(emp) & np.isfinite(ana) & (weight > 0)
+    if not ok.any():
+        return {"weighted_rel_err": None, "max_rel_err": None, "compared": 0}
+    rel = np.abs(emp[ok] - ana[ok]) / ana[ok]
+    w = weight[ok] / weight[ok].sum()
+    return {
+        "weighted_rel_err": float((rel * w).sum()),
+        "max_rel_err": float(rel.max()),
+        "compared": int(ok.sum()),
+    }
+
+
+def composed_job_tau(inst, jobs, routes, emp_link, emp_srv) -> np.ndarray:
+    """(J,) the analytic job-total formula with empirical unit delays
+    substituted for 1/(mu - lambda) — the sim-grounded counterpart of
+    `EmpiricalDelays.job_total`, used by the mobility rollout re-base."""
+    num_links = inst.num_pad_links
+    inc = np.asarray(routes.inc_ext, np.float64)[:num_links]          # (L, J)
+    nhop = np.asarray(routes.nhop, np.float64)
+    ul = np.asarray(jobs.ul, np.float64)
+    dl = np.asarray(jobs.dl, np.float64)
+    d_ul = np.maximum(ul[None, :] * emp_link[:, None], nhop[None, :])
+    d_dl = np.maximum(dl[None, :] * emp_link[:, None], nhop[None, :])
+    job_link = np.where(inc > 0, d_ul + d_dl, 0.0).sum(axis=0)
+    job_server = np.maximum(ul * emp_srv[np.asarray(routes.dst)], 1.0)
+    return np.where(np.asarray(jobs.mask), job_link + job_server, 0.0)
+
+
+def _end_to_end(inst, jobs, outcome, state, spec, dt):
+    """Delivered-weighted rel. error of per-stream mean packet delay."""
+    num_links = inst.num_pad_links
+    j = int(jobs.src.shape[-1])
+    ana_l = analytic_link_delay(inst, outcome)
+    ana_s = analytic_server_delay(inst, outcome)
+    inc = np.asarray(outcome.routes.inc_ext, np.float64)[:num_links]  # (L, J)
+    # NaN analytic entries on a traversed link poison the whole path sum, so
+    # that stream drops out of the comparison instead of skewing it
+    path_sum = np.where(inc > 0, ana_l[:, None], 0.0).sum(axis=0)
+    dst = np.asarray(outcome.routes.dst)
+    srv_term = ana_s[dst]
+    # a served destination with no analytic load entry stays NaN -> excluded
+    ana_ul = path_sum + srv_term                                       # (J,)
+    ana_dl = path_sum                                                  # (J,)
+    delivered = np.asarray(state.delivered, np.float64)
+    dsum = np.asarray(state.delay_sum, np.float64)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        emp = np.where(delivered >= 50, dsum / delivered * dt, np.nan)
+    emp_ul, emp_dl = emp[:j], emp[j:]
+    ana = np.concatenate([ana_ul, ana_dl])
+    return _weighted_err(
+        np.concatenate([emp_ul, emp_dl]), ana, delivered
+    )
+
+
+def fidelity_sweep(
+    utils: Sequence[float] = DEFAULT_UTILS,
+    fleet: int = 8,
+    n_nodes: int = 10,
+    num_jobs: int = 4,
+    rounds: int = 5,
+    slots_per_round: int = 1000,
+    margin: float = 5.0,
+    cap: int = 128,
+    seed: int = 0,
+    min_served: int = 50,
+) -> dict:
+    """Run the sweep; returns the JSON-ready record."""
+    topos = [
+        build_topology(
+            generators.barabasi_albert(n_nodes, seed=seed + 100 * i)[0]
+        )
+        for i in range(fleet)
+    ]
+    max_links = max(t.num_links for t in topos)
+    pad = PadSpec(
+        n=-(-n_nodes // 8) * 8,
+        l=-(-max_links // 8) * 8,
+        s=8,
+        j=max(num_jobs, 8),
+    )
+    cases = [
+        make_case(seed + 100 * i, topos[i], pad, num_jobs)
+        for i in range(fleet)
+    ]
+    inst0, jobs0 = cases[0]
+    spec = spec_for(inst0, jobs0, cap=cap)
+    sim = FleetSim(
+        spec, make_policy("baseline"),
+        rounds=rounds, slots_per_round=slots_per_round,
+    )
+    keys = jax.random.split(jax.random.PRNGKey(seed), fleet)
+    bp = jax.jit(baseline_policy)
+
+    sweep = []
+    first = True
+    for u in utils:
+        scaled, outcomes = [], []
+        for i, (inst, jobs) in enumerate(cases):
+            jobs_u, out = scale_to_util(inst, jobs, keys[i], u, policy_fn=bp)
+            scaled.append((inst, jobs_u))
+            outcomes.append(out)
+        insts = stack_instances([c[0] for c in scaled])
+        jobss = stack_instances([c[1] for c in scaled])
+        params_list = [
+            build_sim_params(inst, jobs, margin=margin)
+            for inst, jobs in scaled
+        ]
+        paramss = stack_instances(params_list)
+        init_rates = jnp.stack([jobs.rate for _, jobs in scaled])
+        run = sim.run(insts, jobss, paramss, keys, init_rates=init_rates)
+        # pull the whole fleet state to host ONCE; per-lane slicing below is
+        # numpy, so it can't trigger device compilations after mark_steady
+        st_all = jax.tree_util.tree_map(np.asarray, run.state)
+
+        link_errs, srv_errs, e2e_errs = [], [], []
+        total = {"generated": 0, "delivered": 0, "dropped": 0, "in_flight": 0}
+        for i, (inst, jobs) in enumerate(scaled):
+            st = jax.tree_util.tree_map(lambda x: x[i], st_all)
+            dt = float(params_list[i].dt)
+            emp_l, emp_s = empirical_queue_delays(st, spec, dt, min_served)
+            lam = np.asarray(outcomes[i].delays.link_lambda, np.float64)
+            link_errs.append(
+                _weighted_err(emp_l, analytic_link_delay(inst, outcomes[i]),
+                              np.where(np.isfinite(emp_l), lam, 0.0))
+            )
+            load = np.asarray(outcomes[i].delays.server_load, np.float64)
+            srv_errs.append(
+                _weighted_err(emp_s, analytic_server_delay(inst, outcomes[i]),
+                              np.where(np.isfinite(emp_s), load, 0.0))
+            )
+            e2e_errs.append(_end_to_end(inst, jobs, outcomes[i], st, spec, dt))
+            total["generated"] += int(np.asarray(st.generated).sum())
+            total["delivered"] += int(np.asarray(st.delivered).sum())
+            total["dropped"] += int(np.asarray(st.dropped).sum())
+            total["in_flight"] += int(np.asarray(st.count[:-1]).sum())
+
+        def pool(errs):
+            ok = [e for e in errs if e["weighted_rel_err"] is not None]
+            if not ok:
+                return {"weighted_rel_err": None, "max_rel_err": None,
+                        "compared": 0}
+            return {
+                "weighted_rel_err": float(
+                    np.mean([e["weighted_rel_err"] for e in ok])
+                ),
+                "max_rel_err": float(max(e["max_rel_err"] for e in ok)),
+                "compared": int(sum(e["compared"] for e in ok)),
+            }
+
+        sweep.append({
+            "util": float(u),
+            "link": pool(link_errs),
+            "server": pool(srv_errs),
+            "end_to_end": pool(e2e_errs),
+            **total,
+        })
+        if first:
+            # every program in one full iteration (policy eval, fleet scan,
+            # host analysis) has now compiled; later utilizations must only
+            # swap array values
+            sim.mark_steady()
+            first = False
+
+    gate = [
+        r["link"]["weighted_rel_err"] for r in sweep
+        if r["util"] <= 0.5 and r["link"]["weighted_rel_err"] is not None
+    ]
+    retraces = jaxhooks.unexpected_retraces()
+    record = {
+        "config": {
+            "utils": [float(u) for u in utils],
+            "fleet": fleet, "n_nodes": n_nodes, "num_jobs": num_jobs,
+            "rounds": rounds, "slots_per_round": slots_per_round,
+            "slots": rounds * slots_per_round,
+            "margin": margin, "cap": cap, "seed": seed,
+            "min_served": min_served, "policy": "baseline",
+        },
+        "sweep": sweep,
+        "acceptance": {
+            "max_link_rel_err_util_le_0.5": float(max(gate)) if gate else None,
+            "threshold": 0.10,
+            "pass": bool(gate) and max(gate) <= 0.10,
+            "unexpected_retraces_after_steady": retraces,
+        },
+    }
+    return record
+
+
+def write_record(record: dict, path: str) -> None:
+    with open(path, "w") as f:
+        json.dump(record, f, indent=1)
+        f.write("\n")

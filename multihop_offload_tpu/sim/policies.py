@@ -1,0 +1,131 @@
+"""Policy adapters: offloading decision + forwarding tables for the sim.
+
+The analytic evaluation path (`env.policies.evaluate_spmatrix_policy`)
+composes decision -> route trace -> M/M/1 scoring; the simulator needs the
+same front half (decision + next-hop table) but keeps the scoring to its
+own packet dynamics.  `make_policy` returns a pure function
+
+    policy_fn(inst, jobs_est, node_up, link_up, key) -> SimRoutes
+
+shared by the three methods the drivers benchmark: the trained GNN
+(`agent.policy` forward pass), the congestion-agnostic greedy baseline,
+and local-only compute.  `jobs_est` carries the simulator's *empirical*
+arrival-rate estimates — the policy sees measured traffic, not the ground
+truth the arrival process samples from (closed-loop evaluation).  Failures
+are respected by pricing down links/nodes at +inf before the shortest-path
+step, so re-offloading and re-routing around a failure happens at the next
+policy round without recompilation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from multihop_offload_tpu.env.apsp import (
+    apsp_minplus,
+    next_hop_table,
+    weight_matrix_from_link_delays,
+)
+from multihop_offload_tpu.env.baseline import baseline_unit_delays
+from multihop_offload_tpu.env.offloading import offload_decide
+from multihop_offload_tpu.graphs.instance import Instance, JobSet
+from multihop_offload_tpu.sim.state import SimRoutes
+
+POLICY_KINDS = ("gnn", "baseline", "local")
+
+
+def decide_routes(
+    inst: Instance,
+    jobs_est: JobSet,
+    link_delays: jnp.ndarray,
+    unit_diag: jnp.ndarray,
+    node_up: jnp.ndarray,
+    link_up: jnp.ndarray,
+    key: jax.Array,
+    explore=0.0,
+    prob: bool = False,
+    apsp_fn=None,
+) -> SimRoutes:
+    """Shared decision skeleton on arbitrary unit delays (the sim-side twin
+    of `evaluate_spmatrix_policy`, returning the forwarding table instead
+    of analytic scores)."""
+    inf = jnp.inf
+    link_delays = jnp.where(link_up, link_delays, inf)
+    unit_diag = jnp.where(node_up, unit_diag, inf)
+    w = weight_matrix_from_link_delays(inst.adj, inst.link_index, link_delays)
+    sp = (apsp_fn or apsp_minplus)(w)
+    dec = offload_decide(
+        inst, jobs_est, sp, inst.hop, unit_diag, key, explore, prob
+    )
+    # a destination that became unreachable (failure cut the graph) degrades
+    # to local compute — packets must never chase an infinite-cost route
+    reachable = jnp.isfinite(
+        sp[jobs_est.src, dec.dst]
+    ) & node_up[dec.dst]
+    dst = jnp.where(reachable, dec.dst, jobs_est.src)
+    return SimRoutes(
+        dst=dst.astype(jnp.int32),
+        next_hop=next_hop_table(inst.adj, sp),
+        reach=jnp.isfinite(sp),
+    )
+
+
+def make_policy(
+    kind: str,
+    model=None,
+    variables=None,
+    support=None,
+    explore=0.0,
+    prob: bool = False,
+    apsp_fn=None,
+    fp_fn=None,
+):
+    """Build the per-round policy function for `sim.runner.simulate`."""
+    if kind not in POLICY_KINDS:
+        raise ValueError(f"unknown sim policy '{kind}'; one of {POLICY_KINDS}")
+
+    if kind == "local":
+
+        def local_fn(inst, jobs_est, node_up, link_up, key):
+            n = inst.num_pad_nodes
+            return SimRoutes(
+                dst=jobs_est.src.astype(jnp.int32),
+                next_hop=jnp.zeros((n, n), jnp.int32),   # never consulted
+                reach=jnp.zeros((n, n), bool),
+            )
+
+        return local_fn
+
+    if kind == "baseline":
+
+        def baseline_fn(inst, jobs_est, node_up, link_up, key):
+            link_d, node_d = baseline_unit_delays(inst)
+            return decide_routes(
+                inst, jobs_est, link_d, node_d, node_up, link_up, key,
+                explore=explore, prob=prob, apsp_fn=apsp_fn,
+            )
+
+        return baseline_fn
+
+    if model is None or variables is None:
+        raise ValueError("kind='gnn' needs model and variables")
+
+    def gnn_fn(inst, jobs_est, node_up, link_up, key):
+        from multihop_offload_tpu.agent.actor import (
+            actor_delay_matrix,
+            default_support,
+        )
+
+        sup = default_support(model, inst) if support is None else support
+        actor = actor_delay_matrix(
+            model, variables, inst, jobs_est, sup, fp_fn=fp_fn
+        )
+        return decide_routes(
+            inst, jobs_est, actor.link_delay,
+            jnp.diagonal(actor.delay_matrix),
+            node_up, link_up, key,
+            explore=explore, prob=prob, apsp_fn=apsp_fn,
+        )
+
+    return gnn_fn

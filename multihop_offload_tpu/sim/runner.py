@@ -1,0 +1,202 @@
+"""Closed-loop fleet simulation: one jitted scan-of-scans, vmapped.
+
+Program structure (compiles exactly once per `FleetSim`):
+
+    vmap over fleet instances
+      scan over policy rounds                # R iterations
+        policy_fn(inst, jobs_est, ...)       # re-decide on measured rates
+        scan over slots                      # K iterations of sim_slot_step
+
+The policy runs *inside* the compiled program once per round — an
+unconditional outer-scan step rather than a `lax.cond` on the slot index,
+because under `vmap` a cond executes both branches anyway and the
+round/slot split keeps the hot inner loop free of the policy's APSP.
+`jobs_est` replaces the ground-truth arrival rates with the windowed
+empirical estimate ``packets_generated / (K * dt * ul)`` from the
+*previous* round, so every policy (GNN / baseline / local) is evaluated
+on what it could actually observe; round 0 uses the caller's
+`init_rates` (true rates for fidelity studies, zeros for cold start).
+
+Host-level dynamics (mobility re-wiring rebuilds the topology with NumPy)
+cannot live inside the scan; instead a run is *segmented*: call
+`FleetSim.run` repeatedly, migrating `SimState` queues between topologies
+with `graphs.mobility.migrate_link_state` — every segment reuses the same
+compiled program as long as padded shapes hold (verified by the
+zero-unexpected-retrace gate in `sim.fidelity`).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from multihop_offload_tpu.graphs.instance import Instance, JobSet
+from multihop_offload_tpu.obs import jaxhooks
+from multihop_offload_tpu.obs.registry import registry
+from multihop_offload_tpu.obs.spans import span
+from multihop_offload_tpu.sim.state import (
+    SimParams,
+    SimRoutes,
+    SimSpec,
+    SimState,
+    init_state,
+    liveness_masks,
+)
+from multihop_offload_tpu.sim.step import sim_slot_step
+
+
+@struct.dataclass
+class SimRun:
+    """Result of one simulated segment (leading fleet axis when batched)."""
+
+    state: SimState          # final state, all counters cumulative
+    routes: SimRoutes        # last policy decision in force
+    est_rates: jnp.ndarray   # (R, J) per-round empirical rate estimates
+    sched: jnp.ndarray | None  # (R, K, L) bool schedule trace, if collected
+
+
+def simulate(
+    inst: Instance,
+    jobs: JobSet,
+    spec: SimSpec,
+    params: SimParams,
+    policy_fn: Callable,
+    state: SimState,
+    init_rates: jnp.ndarray,
+    key: jax.Array,
+    rounds: int,
+    slots_per_round: int,
+    collect_schedule: bool = False,
+) -> SimRun:
+    """Run `rounds * slots_per_round` slots on one instance (pure, jittable)."""
+    j = spec.num_jobs
+    n = spec.num_nodes
+    fdt = state.delay_sum.dtype
+
+    def round_body(carry, xs):
+        st, prev_gen, _ = carry
+        kr, is_first = xs
+        k_dec, k_slots = jax.random.split(kr)
+        node_up, link_up = liveness_masks(inst, params, st.t)
+        window = (st.generated - prev_gen)[:j].astype(fdt)
+        denom = (
+            slots_per_round * params.dt.astype(fdt)
+            * jnp.maximum(jobs.ul.astype(fdt), 1e-9)
+        )
+        est = jnp.where(is_first, init_rates.astype(fdt), window / denom)
+        jobs_est = jobs.replace(rate=est.astype(jobs.rate.dtype))
+        routes = policy_fn(inst, jobs_est, node_up, link_up, k_dec)
+
+        def slot_body(s, kk):
+            s2, sched = sim_slot_step(inst, spec, params, routes, jobs, s, kk)
+            return s2, (sched if collect_schedule else None)
+
+        st2, scheds = jax.lax.scan(
+            slot_body, st, jax.random.split(k_slots, slots_per_round)
+        )
+        return (st2, st.generated, routes), (est, scheds)
+
+    routes0 = SimRoutes(
+        dst=jnp.zeros((j,), jnp.int32),
+        next_hop=jnp.zeros((n, n), jnp.int32),
+        reach=jnp.zeros((n, n), bool),
+    )
+    xs = (
+        jax.random.split(key, rounds),
+        jnp.arange(rounds) == 0,
+    )
+    (st_f, _, routes_f), (ests, scheds) = jax.lax.scan(
+        round_body, (state, state.generated, routes0), xs
+    )
+    return SimRun(state=st_f, routes=routes_f, est_rates=ests, sched=scheds)
+
+
+class FleetSim:
+    """Compile-once driver for a fleet of same-shaped instances.
+
+    All static choices (spec, policy, horizon, schedule collection) are
+    fixed at construction; `run` only ever feeds arrays, so repeated
+    segments hit the same executable.  Instrumented through `obs`:
+    `sim/build` wraps construction, `sim/scan` wraps each (blocking)
+    segment, and the `mho_sim_*` metrics accumulate across segments.
+    """
+
+    def __init__(
+        self,
+        spec: SimSpec,
+        policy_fn: Callable,
+        rounds: int,
+        slots_per_round: int,
+        collect_schedule: bool = False,
+        dtype=jnp.float32,
+    ):
+        self.spec = spec
+        self.rounds = rounds
+        self.slots_per_round = slots_per_round
+        self.collect_schedule = collect_schedule
+        self.dtype = dtype
+        with span("sim/build", rounds=rounds, slots=slots_per_round):
+            def one(inst, jobs, params, state, init_rates, key):
+                return simulate(
+                    inst, jobs, spec, params, policy_fn, state,
+                    init_rates, key, rounds, slots_per_round,
+                    collect_schedule,
+                )
+
+            self._fn = jax.jit(jax.vmap(one))
+
+    def init_states(self, fleet: int) -> SimState:
+        s = init_state(self.spec, self.dtype)
+        return jax.tree_util.tree_map(
+            lambda x: jnp.broadcast_to(x, (fleet,) + x.shape), s
+        )
+
+    def run(
+        self,
+        insts: Instance,
+        jobss: JobSet,
+        paramss: SimParams,
+        keys: jax.Array,
+        states: SimState | None = None,
+        init_rates: jnp.ndarray | None = None,
+    ) -> SimRun:
+        """Simulate one segment for the whole (stacked) fleet."""
+        fleet = int(keys.shape[0])
+        if states is None:
+            states = self.init_states(fleet)
+        if init_rates is None:
+            init_rates = jnp.zeros((fleet, self.spec.num_jobs), self.dtype)
+        prev_gen = int(jnp.sum(states.generated))
+        prev_del = int(jnp.sum(states.delivered))
+        prev_drop = int(jnp.sum(states.dropped))
+        with span("sim/scan", block=True, fleet=fleet):
+            out = self._fn(insts, jobss, paramss, states, init_rates, keys)
+            jax.block_until_ready(out.state.t)
+        reg = registry()
+        reg.counter(
+            "mho_sim_slots_total", "simulated slots across the fleet"
+        ).inc(fleet * self.rounds * self.slots_per_round)
+        reg.counter(
+            "mho_sim_policy_rounds_total", "policy re-decisions executed"
+        ).inc(fleet * self.rounds)
+        reg.counter(
+            "mho_sim_packets_generated_total", "packets born"
+        ).inc(int(jnp.sum(out.state.generated)) - prev_gen)
+        reg.counter(
+            "mho_sim_packets_delivered_total", "packets delivered end to end"
+        ).inc(int(jnp.sum(out.state.delivered)) - prev_del)
+        reg.counter(
+            "mho_sim_packets_dropped_total", "packets lost"
+        ).inc(int(jnp.sum(out.state.dropped)) - prev_drop)
+        reg.gauge(
+            "mho_sim_in_flight", "packets queued at segment end"
+        ).set(int(jnp.sum(out.state.count[..., :-1])))
+        return out
+
+    def mark_steady(self) -> None:
+        """Call after the first completed segment: later retraces count as
+        unexpected (`jax_unexpected_retraces_total`)."""
+        jaxhooks.mark_steady()

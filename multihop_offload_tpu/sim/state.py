@@ -1,0 +1,287 @@
+"""Simulator state: fixed-capacity ring-buffer queues + counters as a pytree.
+
+The discrete-time simulator models every packet explicitly, but under XLA
+all queue storage must be static-shape.  One network instance carries
+``Q = 2L + N`` FIFO queues laid out after the extended-line-graph idiom
+(`graphs.instance`): queue ``l in [0, L)`` is link ``l`` in its canonical
+u->v direction, ``L + l`` is the reverse v->u direction (the channel is
+shared — scheduling and service are per *undirected* link — but forwarding
+needs to know which endpoint a packet exits at), and ``2L + i`` is node
+``i``'s server queue.  Each queue is a ring buffer of `cap` packet records
+(stream id, stream-birth slot, queue-entry slot); one extra scratch row
+absorbs masked-out scatter writes, the repo's standard dummy-row trick.
+
+Streams: job ``j`` contributes an uplink packet stream (id ``j``, rate
+``rate_j * ul_j`` packets per time unit, src -> dst -> server) and an
+independent downlink stream (id ``J + j``, rate ``rate_j * dl_j``,
+dst -> src) — the same open-network flow decomposition the analytic
+M/M/1 model applies (`env.queueing.run_empirical` charges links
+``(ul + dl) * rate`` and servers ``ul * rate``), so the two models are
+comparable stream by stream.
+
+Time: one slot is ``dt`` time units, sized so every per-slot probability
+is a valid Bernoulli parameter (`build_sim_params` derives
+``dt = 1 / (margin * max link rate)`` by default); servers may complete
+several packets per slot (deterministic floor + Bernoulli remainder),
+links at most one — a link transmission is a multi-slot geometric hold of
+the shared channel.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from multihop_offload_tpu.graphs.instance import Instance, JobSet
+
+
+@dataclasses.dataclass(frozen=True)
+class SimSpec:
+    """Static (Python-level) sizes: changing any of these recompiles."""
+
+    num_links: int      # L (padded)
+    num_nodes: int      # N (padded)
+    num_jobs: int       # J (padded)
+    cap: int = 64       # ring-buffer capacity per queue
+
+    @property
+    def num_queues(self) -> int:
+        return 2 * self.num_links + self.num_nodes
+
+    @property
+    def num_streams(self) -> int:
+        return 2 * self.num_jobs
+
+
+@struct.dataclass
+class SimParams:
+    """Per-instance dynamics parameters (arrays — value changes never
+    retrace).  Failure schedules use slot -1 for "never fails"."""
+
+    dt: jnp.ndarray             # () slot duration in model time units
+    link_srv_p: jnp.ndarray     # (L,) per-slot completion prob of a held link
+    srv_rate: jnp.ndarray       # (N,) expected server completions per slot
+    arr_p: jnp.ndarray          # (2J,) per-slot packet-arrival prob per stream
+    fail_link_slot: jnp.ndarray  # (L,) int32 slot the link dies (-1 = never)
+    fail_node_slot: jnp.ndarray  # (N,) int32 slot the node dies (-1 = never)
+
+
+@struct.dataclass
+class SimRoutes:
+    """The policy's routing decision, fixed between policy rounds."""
+
+    dst: jnp.ndarray        # (J,) int32 compute destination per job
+    next_hop: jnp.ndarray   # (N, N) int32 greedy forwarding table
+    reach: jnp.ndarray      # (N, N) bool: destination reachable from node
+
+
+@struct.dataclass
+class SimState:
+    """All mutable simulator state for one instance."""
+
+    # ring buffers, (Q + 1, cap): row Q is the masked-write scratch row
+    buf_stream: jnp.ndarray   # int32 stream id of each stored packet
+    buf_birth: jnp.ndarray    # int32 slot the packet entered the network
+    buf_enq: jnp.ndarray      # int32 slot the packet entered THIS queue
+    head: jnp.ndarray         # (Q + 1,) int32 ring head index
+    count: jnp.ndarray        # (Q + 1,) int32 packets stored
+    # conservation counters, per stream (2J,)
+    generated: jnp.ndarray    # int32 packets born (incl. dropped at entry)
+    delivered: jnp.ndarray    # int32 packets that completed their journey
+    dropped: jnp.ndarray      # int32 packets lost (full queue / no route)
+    delay_sum: jnp.ndarray    # float end-to-end slots summed over delivered
+    # per-queue service statistics (Q + 1,)
+    q_sojourn: jnp.ndarray    # float sum of (dequeue - enqueue) slots
+    q_served: jnp.ndarray     # int32 packets dequeued
+    q_busy: jnp.ndarray       # int32 slots with a nonempty queue
+    q_arrived: jnp.ndarray    # int32 packets enqueued
+    sched_slots: jnp.ndarray  # (L,) int32 slots each link won the schedule
+    t: jnp.ndarray            # () int32 current slot
+
+
+def init_state(spec: SimSpec, dtype=jnp.float32) -> SimState:
+    q1 = spec.num_queues + 1
+    c = spec.cap
+    s = spec.num_streams
+    i32 = jnp.int32
+    return SimState(
+        buf_stream=jnp.zeros((q1, c), i32),
+        buf_birth=jnp.zeros((q1, c), i32),
+        buf_enq=jnp.zeros((q1, c), i32),
+        head=jnp.zeros((q1,), i32),
+        count=jnp.zeros((q1,), i32),
+        generated=jnp.zeros((s,), i32),
+        delivered=jnp.zeros((s,), i32),
+        dropped=jnp.zeros((s,), i32),
+        delay_sum=jnp.zeros((s,), dtype),
+        q_sojourn=jnp.zeros((q1,), dtype),
+        q_served=jnp.zeros((q1,), i32),
+        q_busy=jnp.zeros((q1,), i32),
+        q_arrived=jnp.zeros((q1,), i32),
+        sched_slots=jnp.zeros((spec.num_links,), i32),
+        t=jnp.zeros((), i32),
+    )
+
+
+def spec_for(inst: Instance, jobs: JobSet, cap: int = 64) -> SimSpec:
+    return SimSpec(
+        num_links=inst.num_pad_links,
+        num_nodes=inst.num_pad_nodes,
+        num_jobs=int(jobs.src.shape[-1]),
+        cap=cap,
+    )
+
+
+def build_sim_params(
+    inst: Instance,
+    jobs: JobSet,
+    dt: float | None = None,
+    margin: float = 1.25,
+    fail_link_slot: np.ndarray | None = None,
+    fail_node_slot: np.ndarray | None = None,
+) -> SimParams:
+    """Derive slot-level probabilities from the instance's model-time rates.
+
+    `dt` defaults to ``1 / (margin * max real link rate)`` so the busiest
+    link's per-slot completion probability is ``1/margin < 1`` — the
+    geometric service approximation of an exponential server is only valid
+    with per-slot probabilities below 1 (servers are exempt: they drain
+    multiple packets per slot via the floor+Bernoulli split).
+    """
+    rates = np.asarray(inst.link_rates, dtype=np.float64)
+    mask = np.asarray(inst.link_mask)
+    real_max = float(rates[mask].max()) if mask.any() else 1.0
+    if dt is None:
+        dt = 1.0 / (margin * max(real_max, 1e-9))
+    dt = float(dt)
+    link_srv_p = np.where(mask, np.clip(rates * dt, 0.0, 1.0), 0.0)
+    srv_rate = np.asarray(inst.proc_bws, dtype=np.float64) * dt
+
+    rate = np.asarray(jobs.rate, dtype=np.float64)
+    ul = np.asarray(jobs.ul, dtype=np.float64)
+    dl = np.asarray(jobs.dl, dtype=np.float64)
+    jmask = np.asarray(jobs.mask)
+    arr_ul = np.where(jmask, rate * ul * dt, 0.0)
+    arr_dl = np.where(jmask, rate * dl * dt, 0.0)
+    arr_p = np.clip(np.concatenate([arr_ul, arr_dl]), 0.0, 1.0)
+
+    num_links = rates.shape[0]
+    n = srv_rate.shape[0]
+    fls = (np.full((num_links,), -1, np.int32) if fail_link_slot is None
+           else np.asarray(fail_link_slot, np.int32))
+    fns = (np.full((n,), -1, np.int32) if fail_node_slot is None
+           else np.asarray(fail_node_slot, np.int32))
+
+    f = inst.link_rates.dtype
+    return SimParams(
+        dt=jnp.asarray(dt, f),
+        link_srv_p=jnp.asarray(link_srv_p, f),
+        srv_rate=jnp.asarray(srv_rate, f),
+        arr_p=jnp.asarray(arr_p, f),
+        fail_link_slot=jnp.asarray(fls),
+        fail_node_slot=jnp.asarray(fns),
+    )
+
+
+def liveness_masks(inst: Instance, params: SimParams, t: jnp.ndarray):
+    """(node_up (N,), link_up (L,)) at slot `t`: a link is up while its own
+    schedule and both endpoints are alive; padding is always down."""
+    node_up = (params.fail_node_slot < 0) | (t < params.fail_node_slot)
+    node_up = node_up & inst.node_mask
+    u, v = inst.link_ends[:, 0], inst.link_ends[:, 1]
+    link_up = (params.fail_link_slot < 0) | (t < params.fail_link_slot)
+    link_up = link_up & node_up[u] & node_up[v] & inst.link_mask
+    return node_up, link_up
+
+
+def migrate_sim_state(
+    state: SimState, link_map: np.ndarray, spec: SimSpec
+) -> SimState:
+    """Carry one lane's queue state across a mobility topology update.
+
+    Host-side companion of `graphs.mobility.migrate_link_state` for the
+    segmented-run pattern (see `sim.runner`): `link_map[i]` is the old
+    canonical id of new link `i` (-1 = new link).  Both direction queues of
+    a surviving link follow it to its new id with their packets and service
+    statistics; server queues and the global counters carry over unchanged.
+    Packets stranded in queues of vanished links are lost at the re-wiring
+    boundary and counted into `dropped` per stream, so `conservation_gap`
+    stays zero across segments.  Padded shapes must match `spec` (the whole
+    point of the pattern is to reuse the compiled program).
+    """
+    num_links, n, c = spec.num_links, spec.num_nodes, spec.cap
+    q1 = spec.num_queues + 1
+    link_map = np.asarray(link_map, np.int64)
+
+    # perm[new_row] = old_row, or -1 for rows that start out empty
+    perm = np.full((q1,), -1, np.int64)
+    nl = min(link_map.shape[0], num_links)
+    for i in range(nl):
+        j = int(link_map[i])
+        if j >= 0:
+            perm[i] = j
+            perm[num_links + i] = num_links + j
+    perm[2 * num_links:2 * num_links + n] = np.arange(
+        2 * num_links, 2 * num_links + n
+    )
+    keep = perm >= 0
+    src = np.where(keep, perm, 0)
+
+    def rows(a):
+        a = np.asarray(a)
+        sel = keep.reshape((-1,) + (1,) * (a.ndim - 1))
+        return np.where(sel, a[src], 0).astype(a.dtype)
+
+    # packets stranded in unclaimed rows are dropped at the boundary
+    claimed = np.zeros((q1,), bool)
+    claimed[perm[keep]] = True
+    claimed[q1 - 1] = True  # scratch row holds garbage, never real packets
+    old_head = np.asarray(state.head)
+    old_count = np.asarray(state.count)
+    old_stream = np.asarray(state.buf_stream)
+    dropped = np.asarray(state.dropped).astype(np.int64).copy()
+    for q in np.flatnonzero(~claimed[: q1 - 1] & (old_count[: q1 - 1] > 0)):
+        idx = (old_head[q] + np.arange(old_count[q])) % c
+        np.add.at(dropped, old_stream[q, idx], 1)
+
+    sched = np.asarray(state.sched_slots)
+    new_sched = np.where(keep[:num_links], sched[src[:num_links]], 0)
+
+    return SimState(
+        buf_stream=jnp.asarray(rows(state.buf_stream)),
+        buf_birth=jnp.asarray(rows(state.buf_birth)),
+        buf_enq=jnp.asarray(rows(state.buf_enq)),
+        head=jnp.asarray(rows(state.head)),
+        count=jnp.asarray(rows(state.count)),
+        generated=jnp.asarray(np.asarray(state.generated)),
+        delivered=jnp.asarray(np.asarray(state.delivered)),
+        dropped=jnp.asarray(
+            dropped.astype(np.asarray(state.dropped).dtype)
+        ),
+        delay_sum=jnp.asarray(np.asarray(state.delay_sum)),
+        q_sojourn=jnp.asarray(rows(state.q_sojourn)),
+        q_served=jnp.asarray(rows(state.q_served)),
+        q_busy=jnp.asarray(rows(state.q_busy)),
+        q_arrived=jnp.asarray(rows(state.q_arrived)),
+        sched_slots=jnp.asarray(new_sched.astype(sched.dtype)),
+        t=jnp.asarray(np.asarray(state.t)),
+    )
+
+
+def in_flight(state: SimState) -> jnp.ndarray:
+    """Total packets currently stored across all real queues."""
+    return jnp.sum(state.count[:-1])
+
+
+def conservation_gap(state: SimState) -> jnp.ndarray:
+    """generated - delivered - dropped - in_flight; zero when no packet was
+    created or destroyed outside the accounted transitions."""
+    return (
+        jnp.sum(state.generated)
+        - jnp.sum(state.delivered)
+        - jnp.sum(state.dropped)
+        - in_flight(state)
+    )

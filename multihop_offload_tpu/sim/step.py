@@ -1,0 +1,221 @@
+"""One slot of the packet simulator, as fixed-shape masked array math.
+
+Per slot, in order (all on the slot-start state, so nothing is served the
+slot it arrives):
+
+1. **Link scheduling** — contenders are up links with backlog; greedy MWIS
+   on the conflict graph (`env.scheduling.local_greedy_mwis`) with
+   backlog-plus-uniform-jitter weights picks a conflict-free active set
+   (jitter breaks equal-backlog ties randomly instead of by index, which
+   would starve high-index links).  A scheduled link completes its
+   head-of-line packet with probability ``rate * dt`` — the geometric
+   multi-slot channel hold whose mean matches the exponential service time
+   the analytic M/M/1 model assumes.  Of the two direction queues sharing
+   the channel, the older head-of-line packet is served first.
+2. **Server drain** — node ``i`` completes ``floor(bw*dt) +
+   Bernoulli(frac(bw*dt))`` packets (capped by its queue); uplink packets
+   completing service are *delivered*.
+3. **Forwarding** — every completed link packet exits at the link's far
+   endpoint and either (a) reaches its destination: downlink packets are
+   delivered, uplink packets join the destination's server queue, or
+   (b) descends the policy's next-hop table one more hop.  A packet whose
+   next hop is invalid (failed link, unreachable destination after a
+   failure) is dropped and counted.
+4. **Arrivals** — per stream, one Bernoulli packet per slot (prob
+   ``rate * size * dt``); uplink packets of local jobs enter the server
+   queue directly, everything else enters its first link queue.
+5. **Enqueue** — forwarded packets and arrivals are appended FIFO; packets
+   racing into the same queue are ordered (links by id, then streams by
+   id) via a one-hot rank cumsum; appends beyond `cap` are dropped and
+   counted.  Masked scatter writes land in the scratch row, the repo's
+   standard dummy-slot trick.
+
+In-flight packets always chase the *current* routing decision: `dest` and
+`next_hop` are read from the live `SimRoutes`, so a policy round that
+re-offloads a job redirects its queued packets too (the decision takes
+effect network-wide, matching how the analytic evaluator re-scores whole
+flows).  Conservation (`generated = delivered + dropped + in-flight`)
+holds exactly by construction; `tests/test_sim.py` asserts it.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from multihop_offload_tpu.env.scheduling import local_greedy_mwis
+from multihop_offload_tpu.graphs.instance import Instance, JobSet
+from multihop_offload_tpu.sim.state import (
+    SimParams,
+    SimRoutes,
+    SimSpec,
+    SimState,
+    liveness_masks,
+)
+
+
+def sim_slot_step(
+    inst: Instance,
+    spec: SimSpec,
+    params: SimParams,
+    routes: SimRoutes,
+    jobs: JobSet,
+    state: SimState,
+    key: jax.Array,
+):
+    """Advance one slot; returns (state', scheduled (L,) bool)."""
+    num_links, n, j = spec.num_links, spec.num_nodes, spec.num_jobs
+    c, q = spec.cap, spec.num_queues
+    i32 = jnp.int32
+    fdt = state.delay_sum.dtype
+    t = state.t
+    k_tie, k_link, k_srv, k_arr = jax.random.split(key, 4)
+
+    node_up, link_up = liveness_masks(inst, params, t)
+    u_end, v_end = inst.link_ends[:, 0], inst.link_ends[:, 1]
+    lidx = jnp.arange(num_links, dtype=i32)
+
+    q_busy = state.q_busy + (state.count > 0).astype(i32)
+
+    # ---- 1. undirected link schedule + geometric completion ----------------
+    cnt_f, cnt_b = state.count[:num_links], state.count[num_links:2 * num_links]
+    backlog = cnt_f + cnt_b
+    contend = (backlog > 0) & link_up
+    wts = jnp.where(
+        contend,
+        backlog.astype(fdt) + jax.random.uniform(k_tie, (num_links,), fdt),
+        0.0,
+    )
+    sched, _ = local_greedy_mwis(inst.adj_conflict, wts, mask=contend)
+    complete = sched & (
+        jax.random.uniform(k_link, (num_links,), fdt) < params.link_srv_p
+    )
+    head_f, head_b = state.head[:num_links], state.head[num_links:2 * num_links]
+    enq_f = state.buf_enq[lidx, head_f]
+    enq_b = state.buf_enq[lidx + num_links, head_b]
+    both = (cnt_f > 0) & (cnt_b > 0)
+    use_f = jnp.where(both, enq_f <= enq_b, cnt_f > 0)
+    src_q = jnp.where(use_f, lidx, lidx + num_links)          # (L,)
+    exit_node = jnp.where(use_f, v_end, u_end)
+
+    hq = state.head[src_q]
+    s_l = state.buf_stream[src_q, hq]
+    birth_l = state.buf_birth[src_q, hq]
+    enq_l = state.buf_enq[src_q, hq]
+
+    sq_w = jnp.where(complete, src_q, q)                      # scratch-masked
+    head = (state.head.at[sq_w].add(1)) % c
+    count = state.count.at[sq_w].add(-1)
+    q_sojourn = state.q_sojourn.at[sq_w].add((t - enq_l).astype(fdt))
+    q_served = state.q_served.at[sq_w].add(1)
+    sched_slots = state.sched_slots + sched.astype(i32)
+
+    # ---- 2. server drain ---------------------------------------------------
+    srows = 2 * num_links + jnp.arange(n, dtype=i32)
+    scnt = state.count[srows]
+    base = jnp.floor(params.srv_rate).astype(i32)
+    frac = params.srv_rate - base.astype(params.srv_rate.dtype)
+    ndraw = base + (jax.random.uniform(k_srv, (n,), fdt) < frac).astype(i32)
+    nserve = jnp.where(node_up, jnp.minimum(scnt, ndraw), 0)
+    posm = (state.head[srows][:, None] + jnp.arange(c, dtype=i32)[None, :]) % c   # (N, C)
+    smask = jnp.arange(c, dtype=i32)[None, :] < nserve[:, None]
+    s_srv = state.buf_stream[srows[:, None], posm]
+    birth_srv = state.buf_birth[srows[:, None], posm]
+    enq_srv = state.buf_enq[srows[:, None], posm]
+    # masked scatter-adds: garbage indices are in-range, their added value 0
+    sf = s_srv.reshape(-1)
+    mf = smask.reshape(-1)
+    delivered = state.delivered.at[sf].add(mf.astype(i32))
+    delay_sum = state.delay_sum.at[sf].add(
+        (t - birth_srv).astype(fdt).reshape(-1) * mf.astype(fdt)
+    )
+    q_sojourn = q_sojourn.at[srows].add(
+        jnp.sum((t - enq_srv).astype(fdt) * smask.astype(fdt), axis=1)
+    )
+    q_served = q_served.at[srows].add(nserve)
+    head = (head.at[srows].add(nserve)) % c
+    count = count.at[srows].add(-nserve)
+
+    # ---- 3. forward completed link packets ---------------------------------
+    dests = jnp.concatenate([routes.dst, jobs.src])           # (2J,)
+    d_l = dests[s_l]
+    at_dest = exit_node == d_l
+    is_ul = s_l < j
+    deliver_now = complete & at_dest & ~is_ul
+    delivered = delivered.at[s_l].add(deliver_now.astype(i32))
+    delay_sum = delay_sum.at[s_l].add(
+        (t - birth_l).astype(fdt) * deliver_now.astype(fdt)
+    )
+    fw = complete & ~deliver_now
+    nxt = routes.next_hop[exit_node, d_l]
+    tgt_link = inst.link_index[exit_node, nxt]
+    edge_ok = inst.adj[exit_node, nxt] > 0
+    dirq = tgt_link + num_links * (exit_node != u_end[tgt_link]).astype(i32)
+    to_server = at_dest & is_ul
+    tgt_q = jnp.where(to_server, 2 * num_links + exit_node, dirq)
+    ok_l = jnp.where(
+        to_server,
+        node_up[exit_node],
+        edge_ok & link_up[tgt_link] & routes.reach[exit_node, d_l],
+    )
+    put_l = fw & ok_l
+    drop_l = fw & ~ok_l
+
+    # ---- 4. arrivals -------------------------------------------------------
+    origin = jnp.concatenate([jobs.src, routes.dst])          # (2J,)
+    offloaded = routes.dst != jobs.src
+    gen_p = (
+        params.arr_p
+        * node_up[origin].astype(fdt)
+        * node_up[dests].astype(fdt)
+        * jnp.concatenate(
+            [jnp.ones((j,), fdt), offloaded.astype(fdt)]
+        )  # downlink streams exist only for offloaded jobs
+    )
+    gen = jax.random.uniform(k_arr, (2 * j,), fdt) < gen_p
+    generated = state.generated + gen.astype(i32)
+    local_entry = origin == dests                             # ul of local jobs
+    nxt_a = routes.next_hop[origin, dests]
+    tl_a = inst.link_index[origin, nxt_a]
+    edge_ok_a = inst.adj[origin, nxt_a] > 0
+    dirq_a = tl_a + num_links * (origin != u_end[tl_a]).astype(i32)
+    tgt_a = jnp.where(local_entry, 2 * num_links + origin, dirq_a)
+    ok_a = jnp.where(
+        local_entry,
+        node_up[origin],
+        edge_ok_a & link_up[tl_a] & routes.reach[origin, dests],
+    )
+    put_a = gen & ok_a
+    drop_a = gen & ~ok_a
+
+    # ---- 5. ordered batched enqueue with capacity drops --------------------
+    m = num_links + 2 * j
+    tgt = jnp.concatenate([tgt_q, tgt_a])                     # (M,)
+    put = jnp.concatenate([put_l, put_a])
+    strm = jnp.concatenate([s_l, jnp.arange(2 * j, dtype=i32)])
+    births = jnp.concatenate([birth_l, jnp.full((2 * j,), t, i32)])
+    onehot = (put[:, None] & (tgt[:, None] == jnp.arange(q, dtype=i32)[None, :]))
+    rank = jnp.cumsum(onehot.astype(i32), axis=0)[jnp.arange(m, dtype=i32), tgt] - 1
+    space_ok = count[tgt] + rank < c
+    final_put = put & space_ok
+    dropped = state.dropped.at[strm].add(
+        (jnp.concatenate([drop_l, drop_a]) | (put & ~space_ok)).astype(i32)
+    )
+    pos = (head[tgt] + count[tgt] + rank) % c
+    row = jnp.where(final_put, tgt, q)                        # scratch-masked
+    buf_stream = state.buf_stream.at[row, pos].set(strm)
+    buf_birth = state.buf_birth.at[row, pos].set(births)
+    buf_enq = state.buf_enq.at[row, pos].set(jnp.full((m,), t, i32))
+    count = count.at[row].add(1)
+    q_arrived = state.q_arrived.at[row].add(1)
+
+    new_state = SimState(
+        buf_stream=buf_stream, buf_birth=buf_birth, buf_enq=buf_enq,
+        head=head, count=count,
+        generated=generated, delivered=delivered, dropped=dropped,
+        delay_sum=delay_sum,
+        q_sojourn=q_sojourn, q_served=q_served, q_busy=q_busy,
+        q_arrived=q_arrived, sched_slots=sched_slots,
+        t=t + 1,
+    )
+    return new_state, sched

@@ -45,6 +45,57 @@ def test_topology_update_link_migration(rng):
     assert (migrated[~keep] == -1).all()
 
 
+def test_random_walk_degenerate_inputs_are_noops():
+    """Empty fleet / zero movers / zero step return the input unchanged
+    (a mobility trace must stall, not crash, on a degenerate slot)."""
+    p0, a0 = random_walk(np.zeros((0, 2)), rng=np.random.default_rng(2))
+    assert p0.shape == (0, 2) and a0.shape == (0, 0)
+
+    pos = np.array([[0.0, 0.0], [0.5, 0.0]])
+    for kw in (dict(n_moving=0), dict(step_std=0.0)):
+        p, a = random_walk(pos, radius=1.0, rng=np.random.default_rng(3), **kw)
+        np.testing.assert_array_equal(p, pos)
+        assert build_topology(a).connected
+        assert np.isfinite(p).all()
+
+
+def test_random_walk_exhausted_budget_falls_back_to_no_move():
+    """When no connected perturbation exists within the budget, the walk
+    returns the unperturbed (connected) graph instead of raising; a walk
+    from an already-disconnected graph still raises."""
+    pos = np.array([[0.0, 0.0], [0.5, 0.0]])
+    # std=100 clipped to (-10, 10): every candidate separates the pair
+    new_pos, new_adj = random_walk(
+        pos, n_moving=1, step_std=100.0, radius=1.0, bounds=(-10.0, 10.0),
+        rng=np.random.default_rng(0), max_tries=5,
+    )
+    np.testing.assert_array_equal(new_pos, pos)
+    assert build_topology(new_adj).connected
+
+    with pytest.raises(RuntimeError, match="no connected perturbation"):
+        random_walk(np.array([[0.0, 0.0], [5.0, 0.0]]), n_moving=1,
+                    step_std=0.1, radius=1.0, rng=np.random.default_rng(1),
+                    max_tries=3)
+
+
+def test_linkless_topology_update_has_no_nan():
+    """A re-wiring step that lands on a linkless graph must not emit NaN
+    (np.nanmedian of zero link distances used to warn and poison the
+    conflict threshold) and link-state migration must stay shape-correct."""
+    import warnings
+
+    old = build_topology(np.array([[0, 1], [1, 0]]))
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        new, link_map = topology_update(
+            old, np.zeros((2, 2)), pos=np.zeros((2, 2)), cf_radius=1.0,
+        )
+    assert new.num_links == 0 and link_map.shape == (0,)
+    assert new.adj_conflict.shape == (0, 0)
+    migrated = migrate_link_state(link_map, np.arange(1, dtype=np.float64))
+    assert migrated.shape == (0,)
+
+
 def _fake_test_csv(tmp_path):
     rows = []
     for n_nodes in [20, 30]:

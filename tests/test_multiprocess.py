@@ -13,6 +13,28 @@ import socket
 import subprocess
 import sys
 
+import jax
+import pytest
+
+_JAX_VERSION = tuple(int(x) for x in jax.__version__.split(".")[:2])
+
+# The psum child imports the top-level `jax.shard_map` alias (jax >= 0.6);
+# older jax only ships `jax.experimental.shard_map`.
+_needs_toplevel_shard_map = pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason=f"jax {jax.__version__} has no top-level jax.shard_map",
+)
+
+# Cross-process collectives on the CPU backend (the children force
+# jax_platforms=cpu) raise `XlaRuntimeError: Multiprocess computations
+# aren't implemented on the CPU backend` before jax 0.5's DCN-over-gRPC
+# CPU path; the test can only exercise the real multi-host wiring there.
+_needs_cpu_multiprocess = pytest.mark.skipif(
+    _JAX_VERSION < (0, 5),
+    reason=f"jax {jax.__version__} cannot run multiprocess computations "
+    "on the CPU backend",
+)
+
 _CHILD = r'''
 import os, sys
 sys.path.insert(0, os.environ["MHO_REPO"])
@@ -91,6 +113,8 @@ def _run_children(child_src: str, xla_flags: str = "", timeout: int = 240):
     return outs
 
 
+@_needs_toplevel_shard_map
+@_needs_cpu_multiprocess
 def test_two_process_distributed_psum():
     _run_children(_CHILD)
 
@@ -141,6 +165,7 @@ print(f"PROC {pid} OK", flush=True)
 '''
 
 
+@_needs_cpu_multiprocess
 def test_two_process_data_parallel_training_step():
     """TRUE multi-host DP: each process contributes its OWN episodes into a
     4-device (2 processes x 2 devices) mesh via `global_batch`, one
