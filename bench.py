@@ -80,6 +80,38 @@ def _peak_tflops(device_kind: str):
     return None
 
 
+# Published HBM bandwidth per chip (GB/s), same substring lookup.  The bench
+# step is bandwidth-bound (BENCH_r05: arithmetic intensity ~0.117), so the
+# fraction of peak HBM is the honest utilization number, not MFU.
+_PEAK_HBM_GBPS_BY_KIND = (
+    ("v6", 1640.0),  # Trillium
+    ("v5p", 2765.0),
+    ("v5e", 819.0),
+    ("v5", 819.0),
+    ("v4", 1228.0),
+    ("v3", 900.0),
+    ("v2", 700.0),
+)
+
+
+def _peak_hbm_gbps(device_kind: str):
+    kind = (device_kind or "").lower()
+    for sub, peak in _PEAK_HBM_GBPS_BY_KIND:
+        if sub in kind:
+            return peak
+    return None
+
+
+def _bench_precision():
+    """The bench's mixed-precision policy, from BENCH_PRECISION (fp32 | bf16 |
+    auto; default fp32 — the committed baseline records stay comparable).
+    Resolved from the env in both the builder and the measurement so the two
+    never disagree; `scripts/precision_ab.py` flips this knob per leg."""
+    from multihop_offload_tpu.precision import resolve_precision
+
+    return resolve_precision(os.environ.get("BENCH_PRECISION", "fp32"))
+
+
 def _hand_flop_count(pad_n, pad_l, pad_e, batch, cheb_k=1, layers=5, hidden=32,
                      fp_iters=10):
     """Analytic FLOPs/step sanity check for the cost-analysis number.
@@ -144,6 +176,8 @@ def build_bench_batch():
     num_networks = int(os.environ.get("BENCH_NETWORKS", 16))
     per_network = int(os.environ.get("BENCH_INSTANCES", 4))
     arrival_scale = 0.15
+    pol = _bench_precision()
+    storage = pol.storage_dtype  # bf16 halves the batch's HBM working set
     rng = np.random.default_rng(0)
     recs = _load_cases(num_networks, rng)
     pad = PadSpec.for_cases([r.sizes for r in recs], round_to=8)
@@ -161,29 +195,33 @@ def build_bench_batch():
     for rec in recs:
         rates = sample_link_rates(rec.topo, rec.link_rates, rng=rng)
         inst = build_instance(
-            rec.topo, rec.roles, rec.proc_bws, rates, 1000.0, pad, np.float32
+            rec.topo, rec.roles, rec.proc_bws, rates, 1000.0, pad, storage
         )
         for _ in range(per_network):
             mobile = rng.permutation(rec.mobile_nodes)
             nj = int(rng.integers(max(int(0.3 * mobile.size), 1), mobile.size))
             jobsets.append(build_jobset(
                 mobile[:nj], arrival_scale * rng.uniform(0.1, 0.5, nj),
-                pad_jobs=pad.j, dtype=np.float32,
+                pad_jobs=pad.j, dtype=storage,
             ))
             insts.append(inst)
     binst = stack_instances(insts)
     bjobs = stack_instances(jobsets)
     batch = len(insts)
 
-    model = ChebNet(param_dtype=jnp.float32)
+    model = ChebNet(
+        param_dtype=pol.param_dtype,
+        compute_dtype=pol.compute_dtype if pol.mixed else None,
+        accum_dtype=pol.accum_dtype if pol.mixed else None,
+    )
     ckpt = "/root/reference/model/model_ChebConv_BAT800_a5_c5_ACO_agent"
     if os.path.isdir(ckpt):
-        variables = load_reference_checkpoint(ckpt, dtype=np.float32)
+        variables = load_reference_checkpoint(ckpt, dtype=pol.param_dtype)
     else:
         variables = model.init(
             jax.random.PRNGKey(0),
-            jnp.zeros((pad.e, 4), jnp.float32),
-            jnp.zeros((pad.e, pad.e), jnp.float32),
+            jnp.zeros((pad.e, 4), storage),
+            jnp.zeros((pad.e, pad.e), storage),
         )
     return model, variables, binst, bjobs, pad, batch
 
@@ -195,7 +233,7 @@ def measure():
     apply_platform_env()
 
     import jax
-    import jax.numpy as jnp  # noqa: F401
+    import jax.numpy as jnp
 
     from multihop_offload_tpu.agent import forward_backward
 
@@ -237,6 +275,10 @@ def measure():
 
         apsp_fn = _ft.partial(_apsp, early_stop=False)
         apsp_path = "xla-static"
+    # mixed-precision policy: narrow the APSP operands under bf16 (the fixed
+    # point islands itself to fp32 internally — no wrap needed on fp_fn)
+    precision = _bench_precision()
+    apsp_fn = precision.wrap_apsp(apsp_fn)
 
     @jax.jit
     def step(variables, insts, jobs, keys):
@@ -255,6 +297,7 @@ def measure():
     # fields (VERDICT r3 item 2).
     run = step
     flops_per_step = bytes_per_step = None
+    argument_bytes = temp_bytes = None
     t_compile = time.time()
     try:
         with span("bench/compile"):
@@ -266,6 +309,16 @@ def measure():
         if ca:
             flops_per_step = float(ca.get("flops", 0.0)) or None
             bytes_per_step = float(ca.get("bytes accessed", 0.0)) or None
+        # buffer-assignment view: argument bytes are what the step reads per
+        # call (the storage the precision policy halves); off-TPU this is
+        # the byte metric that still tracks dtype — CPU lowering upcasts
+        # bf16 compute to f32, so cost-analysis bytes barely move there
+        mem = compiled.memory_analysis()
+        if mem is not None:
+            argument_bytes = float(
+                getattr(mem, "argument_size_in_bytes", 0.0)) or None
+            temp_bytes = float(
+                getattr(mem, "temp_size_in_bytes", 0.0)) or None
     except Exception as exc:  # cost analysis is diagnostic, never fatal
         print(f"warning: AOT cost_analysis unavailable: {exc}", file=sys.stderr)
     if runlog is not None:
@@ -301,6 +354,10 @@ def measure():
     steps_per_sec = reps / dt
     device_kind = getattr(jax.devices()[0], "device_kind", "")
     peak = _peak_tflops(device_kind)
+    peak_hbm = _peak_hbm_gbps(device_kind)
+    achieved_hbm_gbps = (
+        bytes_per_step * steps_per_sec / 1e9 if bytes_per_step else None
+    )
     flops_corrected = (
         _loop_corrected_flops(flops_per_step, pad.n, pad.l, batch,
                               fp_path=fp_path)
@@ -321,11 +378,15 @@ def measure():
         "platform": platform,
         "apsp_path": apsp_path,
         "fp_path": fp_path,
+        "precision": precision.name,
         "roofline": {
+            "compute_dtype": str(jnp.dtype(precision.compute_dtype)),
             "flops_per_step": flops_per_step,
             "flops_per_step_corrected": flops_corrected,
             "flops_per_step_hand": _hand_flop_count(pad.n, pad.l, pad.e, batch),
             "bytes_per_step": bytes_per_step,
+            "argument_bytes": argument_bytes,
+            "temp_bytes": temp_bytes,
             "arithmetic_intensity": (
                 round(flops_corrected / bytes_per_step, 3)
                 if flops_corrected and bytes_per_step else None
@@ -333,9 +394,18 @@ def measure():
             "achieved_tflops": (
                 round(achieved_tflops, 4) if achieved_tflops is not None else None
             ),
+            "achieved_hbm_gbps": (
+                round(achieved_hbm_gbps, 3)
+                if achieved_hbm_gbps is not None else None
+            ),
             "device_kind": device_kind,
             "peak_tflops_bf16": peak,
+            "peak_hbm_gbps": peak_hbm,
             "mfu": mfu,
+            "hbm_frac_of_peak": (
+                round(achieved_hbm_gbps / peak_hbm, 5)
+                if achieved_hbm_gbps is not None and peak_hbm else None
+            ),
             "note": "flops_per_step is raw XLA cost_analysis on the "
                     "compiled step (fwd+bwd, whole batch); cost_analysis "
                     "charges scan/loop bodies once and Pallas custom-call "

@@ -50,6 +50,7 @@ from multihop_offload_tpu.env.queueing import (
 )
 from multihop_offload_tpu.env.routing import RouteSet, trace_routes
 from multihop_offload_tpu.graphs.instance import Instance, JobSet
+from multihop_offload_tpu.precision import island_dtype
 
 
 @struct.dataclass
@@ -67,9 +68,19 @@ def _critic_loss(
     inst: Instance, jobs: JobSet, routes_inc: jnp.ndarray, fp_fn=None
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Analytic congestion-model delay of fixed routes
-    (`gnn_offloading_agent.py:333-374`).  Returns (loss, unit_edge)."""
+    (`gnn_offloading_agent.py:333-374`).  Returns (loss, unit_edge).
+
+    Runs in the fp32 island (`precision.FP32_ISLANDS`: "fixed_point" +
+    "delay_reduction"): the caller hands routes_inc in >= fp32, the load
+    accumulation below re-promotes defensively, and the fixed point widens
+    its own operands — so the `1/(mu - lambda)` terms this loss is
+    differentiated through never see bf16."""
     num_links = inst.num_pad_links
-    load = routes_inc @ jnp.where(jobs.mask, jobs.rate * jobs.ul, 0.0)  # (E,)
+    dt = island_dtype(routes_inc.dtype, jobs.rate.dtype)
+    routes_inc = routes_inc.astype(dt)
+    load = routes_inc @ jnp.where(
+        jobs.mask, jobs.rate.astype(dt) * jobs.ul.astype(dt), 0.0
+    )  # (E,)
     link_lambda = load[:num_links]
     node_lambda = jnp.where(inst.comp_mask, load[num_links:], 0.0)
 
@@ -92,7 +103,7 @@ def _critic_loss(
     unit_edge = jnp.concatenate([link_delay, node_delay])        # (E,)
     # delay per (slot, job): max(data * unit * r, r); multiply_no_nan
     # semantics via a mask (`:370-372`)
-    data = jobs.ul + jobs.dl                                     # (J,)
+    data = jobs.ul.astype(dt) + jobs.dl.astype(dt)               # (J,)
     prod = jnp.where(routes_inc > 0, unit_edge[:, None] * routes_inc, 0.0)
     delay_job_edge = jnp.maximum(data[None, :] * prod, routes_inc)
     return jnp.sum(delay_job_edge), unit_edge
@@ -208,9 +219,13 @@ def forward_backward(
     delays = run_empirical(inst, jobs, routes, fp_fn=fp_fn)
 
     # --- 3. critic gradient w.r.t. routes -------------------------------
+    # fp32-island(fixed_point): differentiate from a wide incidence so
+    # grad_routes — and the whole suffix-bias chain it feeds — carries
+    # fp32 gradient signal even when routes are stored bf16
+    routes_inc_wide = routes.inc_ext.astype(island_dtype(routes.inc_ext.dtype))
     (loss_critic, unit_edge), grad_routes = jax.value_and_grad(
         lambda r: _critic_loss(inst, jobs, r, fp_fn=fp_fn), has_aux=True
-    )(routes.inc_ext)
+    )(routes_inc_wide)
 
     # --- 4. suffix-bias gradient onto unit delays -----------------------
     # (critic_weight scales the reference's policy-sensitivity term; 1.0 is

@@ -48,7 +48,7 @@ def build_service(cfg: Config, pool=None):
         slots=cfg.serve_slots, queue_cap=cfg.serve_queue_cap,
         deadline_s=cfg.serve_deadline_s, seed=cfg.seed, prob=cfg.prob,
         apsp_impl=cfg.apsp_impl, fp_impl=cfg.fp_impl,
-        dtype=cfg.jnp_dtype,
+        dtype=cfg.jnp_dtype, precision=cfg.precision_policy,
     )
     loaded = service.hot_reload(cfg.model_dir())
     print("serving with "

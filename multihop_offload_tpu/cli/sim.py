@@ -58,7 +58,8 @@ def _make_gnn_policy(cfg: Config, pad):
     print("sim gnn policy: "
           + (f"checkpoint step {loaded}" if loaded is not None
              else "fresh-init weights"))
-    return make_policy("gnn", model=model, variables=variables)
+    return make_policy("gnn", model=model, variables=variables,
+                       precision=cfg.precision_policy)
 
 
 def run_scenarios(cfg: Config, steady: bool = True) -> dict:
@@ -130,7 +131,7 @@ def run_scenarios(cfg: Config, steady: bool = True) -> dict:
     if cfg.sim_policy == "gnn":
         policy = _make_gnn_policy(cfg, pad)
     else:
-        policy = make_policy(cfg.sim_policy)
+        policy = make_policy(cfg.sim_policy, precision=cfg.precision_policy)
 
     inst0, jobs0 = cases[0]
     spec = spec_for(inst0, jobs0, cap=cfg.sim_cap)

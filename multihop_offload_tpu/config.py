@@ -65,6 +65,18 @@ class Config:
 
     # ---- TPU-native knobs -------------------------------------------------
     dtype: str = "float32"         # computation dtype ("float64" for parity)
+    precision: str = "fp32"        # mixed-precision compute policy:
+    #                                fp32 | bf16 | auto.  fp32 = identity
+    #                                (everything in `dtype` — the default
+    #                                until the precision_ab gates pass on
+    #                                chip); bf16 = bfloat16 storage/compute
+    #                                with fp32 params, fp32 matmul
+    #                                accumulation, and the fp32 islands of
+    #                                multihop_offload_tpu/precision.py
+    #                                (fixed point, tau reductions, decision
+    #                                costs, Laplacian constants); auto =
+    #                                bf16 on a TPU backend, fp32 elsewhere.
+    #                                See docs/OPERATIONS.md "Precision".
     apsp_impl: str = "xla"         # all-pairs-shortest-path kernel for the
     #                                decision paths: xla | pallas | auto.
     #                                auto = fastest measured path per shape
@@ -177,6 +189,15 @@ class Config:
                 f"unsupported dtype '{self.dtype}'; choose one of {sorted(table)}"
             )
         return table[self.dtype]
+
+    @property
+    def precision_policy(self):
+        """The resolved `multihop_offload_tpu.precision.PrecisionPolicy` for
+        this (precision, dtype) pair — build-time configuration, resolved
+        once per consumer and baked into closures (never traced)."""
+        from multihop_offload_tpu.precision import resolve_precision
+
+        return resolve_precision(self.precision, self.jnp_dtype)
 
     def model_dir(self, root: Optional[str] = None) -> str:
         """Checkpoint directory; naming mirrors `AdHoc_train.py:59`."""

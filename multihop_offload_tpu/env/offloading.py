@@ -16,6 +16,7 @@ import jax.numpy as jnp
 from flax import struct
 
 from multihop_offload_tpu.graphs.instance import Instance, JobSet
+from multihop_offload_tpu.precision import island_dtype
 
 
 @struct.dataclass
@@ -42,18 +43,27 @@ def offload_decide(
     (the reference zeroes the diagonal before use, `offloading_v3.py:396-397`).
     `unit_diag`: (N,) per-node unit processing delays — the diagonal the
     caller would have written into the SP matrix (`:395`).
+
+    fp32 ISLAND (`precision.FP32_ISLANDS`: "decision_costs"): under the
+    bf16 policy the SP matrix arrives narrow; its (J, S) gathers — not the
+    (N, N) matrix — are upcast and the cost table is re-accumulated >= fp32
+    before the argmin, so near-ties degrade by gather rounding only, never
+    by quantizing whole cost rows.  A no-op under the identity policy.
     """
     servers = inst.servers                       # (S,) ascending
     smask = inst.server_mask
     src = jobs.src
 
-    local_delay = unit_diag[src] * jobs.ul                       # (J,)
-    ul = sp[src[:, None], servers[None, :]] * jobs.ul[:, None]   # (J, S)
-    dl = sp[servers[None, :], src[:, None]] * jobs.dl[:, None]
-    proc = unit_diag[servers][None, :] * jobs.ul[:, None]
+    dt = island_dtype(sp.dtype, unit_diag.dtype, jobs.ul.dtype)
+    ul_d = jobs.ul.astype(dt)
+    dl_d = jobs.dl.astype(dt)
+    local_delay = unit_diag[src].astype(dt) * ul_d               # (J,)
+    ul = sp[src[:, None], servers[None, :]].astype(dt) * ul_d[:, None]  # (J, S)
+    dl = sp[servers[None, :], src[:, None]].astype(dt) * dl_d[:, None]
+    proc = unit_diag[servers].astype(dt)[None, :] * ul_d[:, None]
     # lower bounds: hop counts for transport, 1 for processing (:411-413)
-    ul = jnp.maximum(ul, hop[src[:, None], servers[None, :]])
-    dl = jnp.maximum(dl, hop[servers[None, :], src[:, None]])
+    ul = jnp.maximum(ul, hop[src[:, None], servers[None, :]].astype(dt))
+    dl = jnp.maximum(dl, hop[servers[None, :], src[:, None]].astype(dt))
     proc = jnp.maximum(proc, 1.0)
     server_delays = ul + dl + proc                               # (J, S)
 

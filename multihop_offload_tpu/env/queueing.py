@@ -24,6 +24,7 @@ from jax import lax
 
 from multihop_offload_tpu.graphs.instance import Instance, JobSet
 from multihop_offload_tpu.env.routing import RouteSet
+from multihop_offload_tpu.precision import island_dtype
 
 
 @struct.dataclass
@@ -75,10 +76,19 @@ def interference_fixed_point(
     (`gnn_offloading_agent.py:240-244`, `:348-352`).  `fp_fn` overrides the
     XLA scan with a drop-in core (the `fp_impl` knob resolves to the Pallas
     VMEM-resident kernel, `ops.fixed_point.resolve_fixed_point`).
+
+    This is an fp32 ISLAND (`precision.FP32_ISLANDS`: "fixed_point"): the
+    M/M/1 denominators `1 - lambda/mu` near saturation lose the gradient
+    signal in bf16, so every operand is promoted to >= fp32 before the core
+    — the XLA scan and the Pallas kernel alike then iterate wide, and the
+    returned mu keeps downstream delay math wide by dtype promotion.  A
+    no-op under the identity (fp32/fp64) policy.
     """
+    dt = island_dtype(link_lambda.dtype, inst.link_rates.dtype)
     fp = fp_fn or interference_fixed_point_raw
     return fp(
-        inst.adj_conflict, inst.link_rates, inst.cf_degs, link_lambda, num_iters
+        inst.adj_conflict.astype(dt), inst.link_rates.astype(dt),
+        inst.cf_degs.astype(dt), link_lambda.astype(dt), num_iters
     )
 
 
@@ -87,10 +97,21 @@ def run_empirical(
 ) -> EmpiricalDelays:
     num_links = inst.num_pad_links
     n = inst.num_pad_nodes
-    inc = routes.inc_ext[:num_links]              # (L, J)
+    # fp32-island(delay_reduction): the arrival accumulation, every
+    # 1/(mu - lambda) unit delay, and the per-job totals run >= fp32 —
+    # bf16 routes/rates feed in, wide EmpiricalDelays come out.  lambda
+    # accuracy feeds the fixed point's denominators directly, so the
+    # incidence matmul is re-accumulated wide, not just its result.
+    dt = island_dtype(
+        routes.inc_ext.dtype, jobs.rate.dtype, inst.link_rates.dtype
+    )
+    inc = routes.inc_ext[:num_links].astype(dt)   # (L, J)
     jmask = jobs.mask
-    ul_rate = jobs.ul * jobs.rate
-    dl_rate = jobs.dl * jobs.rate
+    ul = jobs.ul.astype(dt)
+    dl = jobs.dl.astype(dt)
+    nhop = routes.nhop.astype(dt)
+    ul_rate = ul * jobs.rate.astype(dt)
+    dl_rate = dl * jobs.rate.astype(dt)
 
     link_lambda = inc @ (ul_rate + dl_rate)       # (L,)  (`:494`)
     server_load = jnp.zeros((n,), dtype=ul_rate.dtype).at[routes.dst].add(
@@ -105,28 +126,28 @@ def run_empirical(
     safe_slack = jnp.where(congested_l, 1.0, slack)
     unit_ok = 1.0 / safe_slack
     unit_cong = inst.T * link_lambda[:, None] / (
-        (jobs.ul + jobs.dl)[None, :] * link_mu[:, None]
+        (ul + dl)[None, :] * link_mu[:, None]
     )
     unit_lj = jnp.where(congested_l[:, None], unit_cong, unit_ok[:, None])
 
     # per-link per-job empirical delay, only on traversed links (`:542`)
-    d_ul = jnp.maximum(jobs.ul[None, :] * unit_lj, routes.nhop[None, :])
-    d_dl = jnp.maximum(jobs.dl[None, :] * unit_lj, routes.nhop[None, :])
+    d_ul = jnp.maximum(ul[None, :] * unit_lj, nhop[None, :])
+    d_dl = jnp.maximum(dl[None, :] * unit_lj, nhop[None, :])
     # untraversed (link, job) pairs may hold inf/NaN (e.g. zero-rate links the
     # reference simply never visits) — mask before summing, don't multiply
     job_link = jnp.sum(jnp.where(inc > 0, d_ul + d_dl, 0.0), axis=0)
 
     # server component (`:545-549`)
-    bw = inst.proc_bws[routes.dst]
+    bw = inst.proc_bws[routes.dst].astype(dt)
     sload = server_load[routes.dst]
     s_slack = bw - sload
     s_cong = s_slack <= 0.0
     unit_s = jnp.where(
         s_cong,
-        inst.T * sload / (jobs.ul * jnp.where(bw > 0, bw, 1.0)),
+        inst.T * sload / (ul * jnp.where(bw > 0, bw, 1.0)),
         1.0 / jnp.where(s_cong, 1.0, s_slack),
     )
-    job_server = jnp.maximum(jobs.ul * unit_s, 1.0)
+    job_server = jnp.maximum(ul * unit_s, 1.0)
 
     job_link = jnp.where(jmask, job_link, 0.0)
     job_server = jnp.where(jmask, job_server, 0.0)

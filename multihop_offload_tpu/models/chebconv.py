@@ -35,7 +35,14 @@ class ChebConv(nn.Module):
     channels: int
     k: int = 1
     use_bias: bool = True
-    param_dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32  # fp32-island(params: bf16 loses small updates)
+    # mixed precision (precision.PrecisionPolicy): activations/support/kernel
+    # are narrowed to `compute_dtype` for the matmuls and the feature matmuls
+    # accumulate in `accum_dtype` via preferred_element_type — params stay
+    # `param_dtype`.  None (default) = run everything in the input dtype,
+    # the identity-policy behavior.
+    compute_dtype: Optional[jnp.dtype] = None
+    accum_dtype: Optional[jnp.dtype] = None
     # graph-propagation op (support, activations) -> activations; the default
     # is the dense on-chip matmul.  `parallel.partition` swaps in a
     # halo-exchange matmul to row-shard the graph across a mesh axis while
@@ -48,15 +55,28 @@ class ChebConv(nn.Module):
         kernel = self.param(
             "kernel", _glorot, (self.k, x.shape[-1], self.channels), self.param_dtype
         )
+        if self.compute_dtype is not None:
+            x = x.astype(self.compute_dtype)
+            support = support.astype(self.compute_dtype)
+            kernel = kernel.astype(self.compute_dtype)
+
+        def feat_mm(t, w):
+            # feature matmul: narrow operands, wide accumulation — the
+            # "bf16 matmuls with preferred_element_type=fp32" contract
+            return jnp.matmul(t, w, preferred_element_type=self.accum_dtype)
+
         prop = self.propagate if self.propagate is not None else jnp.matmul
         t_prev2 = x
-        out = t_prev2 @ kernel[0]
+        out = feat_mm(t_prev2, kernel[0])
         if self.k > 1:
+            # the Chebyshev recursion itself stays in the compute dtype: its
+            # values are spectrally bounded (|T_k| <= 1 on a rescaled
+            # support) and keeping it narrow is where the HBM win lives
             t_prev = prop(support, x)
-            out = out + t_prev @ kernel[1]
+            out = out + feat_mm(t_prev, kernel[1])
             for i in range(2, self.k):
                 t_cur = 2.0 * prop(support, t_prev) - t_prev2
-                out = out + t_cur @ kernel[i]
+                out = out + feat_mm(t_cur, kernel[i])
                 t_prev2, t_prev = t_prev, t_cur
         if self.use_bias:
             out = out + self.param(
@@ -76,7 +96,9 @@ class ChebNet(nn.Module):
     k: int = 1
     dropout: float = 0.0
     leaky_alpha: float = 0.2
-    param_dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32  # fp32-island(params: bf16 loses small updates)
+    compute_dtype: Optional[jnp.dtype] = None  # see ChebConv
+    accum_dtype: Optional[jnp.dtype] = None
     propagate: Optional[Callable] = None
     # Final-layer bias init.  The reference zero-inits every bias (Keras
     # default), which leaves the single relu output unit dead-at-birth for
@@ -101,6 +123,8 @@ class ChebNet(nn.Module):
                 channels=self.out_dim if last else self.hidden,
                 k=self.k,
                 param_dtype=self.param_dtype,
+                compute_dtype=self.compute_dtype,
+                accum_dtype=self.accum_dtype,
                 propagate=self.propagate,
                 bias_init=(
                     nn.initializers.constant(self.out_bias_init)
@@ -117,6 +141,7 @@ def chebyshev_support(
     mask: Optional[jnp.ndarray] = None,
     lmax: float | None = 2.0,
     compat_raw: bool = False,
+    dtype: Optional[jnp.dtype] = None,
 ) -> jnp.ndarray:
     """Support matrix for ChebConv.
 
@@ -126,9 +151,18 @@ def chebyshev_support(
     rescaled Laplacian 2 L_sym / lmax - I with L_sym = I - D^-1/2 A D^-1/2,
     masked so padded rows stay zero.  `lmax=None` estimates the spectral
     radius with fixed-iteration power iteration (jit-safe).
+
+    The degree normalization, identity, and `lmax` rescale constants are an
+    fp32 island (`precision.FP32_ISLANDS`: "laplacian"): a bf16 adjacency
+    must not downgrade them — the support is built wide and quantized ONCE
+    to `dtype` (default: the adjacency's own dtype) on the way out.
     """
     if compat_raw:
-        return adj
+        return adj if dtype is None else adj.astype(dtype)
+    from multihop_offload_tpu.precision import island_dtype
+
+    out_dtype = adj.dtype if dtype is None else dtype
+    adj = adj.astype(island_dtype(adj.dtype))  # fp32-island(laplacian)
     deg = adj.sum(axis=-1)
     inv_sqrt = jnp.where(deg > 0, 1.0 / jnp.sqrt(jnp.where(deg > 0, deg, 1.0)), 0.0)
     a_norm = adj * inv_sqrt[:, None] * inv_sqrt[None, :]
@@ -144,7 +178,7 @@ def chebyshev_support(
         lmax_val = jnp.maximum(v @ (lap @ v), 1e-6)
     else:
         lmax_val = jnp.asarray(lmax, dtype=adj.dtype)
-    return (2.0 / lmax_val) * lap - eye
+    return ((2.0 / lmax_val) * lap - eye).astype(out_dtype)
 
 
 def ensure_alive_output(model, variables, feats, support, mask=None):
@@ -216,7 +250,16 @@ def ensure_alive_output_multi(model, variables, probes):
     return best
 
 
-def make_model(cfg: Config) -> ChebNet:
+def make_model(cfg: Config, policy=None) -> ChebNet:
+    """Build the actor stack under the configured precision policy.
+
+    `policy` (a `precision.PrecisionPolicy`) defaults to
+    `cfg.precision_policy`: the identity (fp32) policy reproduces the
+    pre-policy model exactly (params/compute in `cfg.jnp_dtype`); the bf16
+    policy keeps fp32 params, narrows matmul operands to bf16, and
+    accumulates in fp32 via `preferred_element_type`.
+    """
+    pol = policy if policy is not None else cfg.precision_policy
     return ChebNet(
         num_layer=cfg.num_layer,
         hidden=cfg.hidden,
@@ -224,5 +267,7 @@ def make_model(cfg: Config) -> ChebNet:
         k=cfg.cheb_k,
         dropout=cfg.dropout,
         leaky_alpha=cfg.leaky_relu_alpha,
-        param_dtype=cfg.jnp_dtype,
+        param_dtype=pol.param_dtype,
+        compute_dtype=pol.compute_dtype if pol.mixed else None,
+        accum_dtype=pol.accum_dtype if pol.mixed else None,
     )

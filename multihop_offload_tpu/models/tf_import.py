@@ -40,7 +40,7 @@ def _checkpoint_prefix(path: str) -> str:
     return path
 
 
-def load_reference_checkpoint(path: str, dtype=np.float32) -> Dict[str, Any]:
+def load_reference_checkpoint(path: str, dtype=np.float32) -> Dict[str, Any]:  # fp32-island(imported params stay wide)
     """Load reference weights into a Flax `{"params": ...}` tree for ChebNet."""
     import tensorflow as tf  # local import: only needed for interop
 

@@ -1,0 +1,184 @@
+"""Mixed-precision compute policy: bf16 the memory-bound bulk, fp32 islands.
+
+The per-instance evaluation is decisively memory-bound on TPU (BENCH_r05:
+arithmetic intensity 0.117, 2.6% MFU, ~22 GB of HBM traffic per step), so the
+fast path is bandwidth, not FLOPs.  The standard TPU answer is to halve the
+working set: run the dense bulk — ChebConv matmuls, the (N, N, N) min-plus
+APSP intermediates, instance/jobset storage and host->device transfer — in
+bfloat16 while keeping the numerically fragile steps in float32.
+
+One `PrecisionPolicy` names the four dtypes every consumer draws from:
+
+- ``param_dtype``   — model parameters (and their grads / optimizer state).
+  Never narrowed below fp32: bf16's 8-bit mantissa loses small gradient
+  updates, and checkpoints keep fp32 parity.
+- ``compute_dtype`` — the memory-bound bulk math (GNN matmuls, APSP).
+- ``accum_dtype``   — matmul accumulation (``preferred_element_type``) and
+  the dtype every fp32 island promotes to.
+- ``storage_dtype`` — host-side Instance/JobSet numpy arrays (what ships
+  over PCIe/ICI and sits in HBM between steps).
+
+The fp32 ISLANDS (named in `FP32_ISLANDS`) are steps whose conditioning
+cannot survive an 8-bit mantissa:
+
+- ``fixed_point``     — the interference fixed point's M/M/1 denominators
+  ``1 - lambda/mu`` near saturation: a bf16 ulp at mu ~ 1 is ~0.8% of the
+  slack, enough to flip a link between "congested" and "fine" and to zero
+  the gradient signal the critic differentiates through.
+- ``delay_reduction`` — the final tau / per-job delay totals ``1/(mu -
+  lambda)`` and their reductions (same denominators, plus long sums).
+- ``decision_costs``  — the offloading cost table: (J, S) gathers read back
+  from the bf16 SP matrix are re-accumulated in fp32 before the argmin, so
+  tie-breaking degrades gracefully instead of quantizing whole cost rows.
+- ``laplacian``       — `chebyshev_support`'s degree normalization and
+  spectral rescale constants (a bf16 adjacency must not downgrade them).
+
+Islands are enforced by DTYPE PROMOTION, not by plumbing: each island site
+upcasts its operands to `island_dtype(...)` (>= fp32), and because JAX
+promotes ``bf16 x f32 -> f32`` everything downstream of an island output
+stays wide until explicitly narrowed.  A policy therefore never travels as
+a traced value — it is resolved once at build time (`resolve_precision`)
+and baked into closures, exactly like the `apsp_impl` / `fp_impl` knobs, so
+enabling it causes zero retraces after steady.
+
+Resolution (`cfg.precision` x `cfg.dtype`):
+
+==========  ===========  ============  ===========  ============
+precision   param        compute       accum        storage
+==========  ===========  ============  ===========  ============
+fp32        base         base          base         base (numpy)
+bf16        >=fp32 base  bfloat16      >=fp32 base  bfloat16
+auto        bf16 on a TPU default backend, fp32 elsewhere
+==========  ===========  ============  ===========  ============
+
+where ``base = cfg.jnp_dtype`` (``fp32`` is the identity policy — bit-for-
+bit the pre-policy behavior — and remains the default until the
+`benchmarks/precision_ab.json` gates pass on the chip).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+PRECISION_CHOICES = ("fp32", "bf16", "auto")
+
+# Named fp32 islands — the per-line lint waivers (`# fp32-island(...)`) and
+# docs/OPERATIONS.md "Precision" refer to these names.
+FP32_ISLANDS = (
+    "fixed_point",      # interference fixed point: 1 - lambda/mu denominators
+    "delay_reduction",  # tau / per-job delay totals and their reductions
+    "decision_costs",   # offload cost table read back from the bf16 SP matrix
+    "laplacian",        # chebyshev_support degree/rescale constants
+)
+
+
+def island_dtype(*dtypes):
+    """Smallest dtype >= float32 that covers every operand dtype.
+
+    The fp32-island upcast rule: f32 for bf16/f32 operands, f64 when any
+    operand is already f64 (the parity/x64 test paths must not be silently
+    truncated).  A no-op cast under the identity (fp32) policy.
+    """
+    import jax.numpy as jnp
+
+    dt = jnp.dtype(jnp.float32)
+    for d in dtypes:
+        dt = jnp.promote_types(dt, d)
+    return dt
+
+
+@dataclasses.dataclass(frozen=True)
+class PrecisionPolicy:
+    """Resolved dtype assignment for one run.  Frozen and hashable: build-
+    time configuration (closure state), never a traced argument."""
+
+    name: str            # resolved leg: "fp32" (identity) | "bf16" (mixed)
+    param_dtype: Any
+    compute_dtype: Any
+    accum_dtype: Any
+    storage_dtype: Any   # numpy-compatible (bf16 via ml_dtypes)
+    islands: tuple = FP32_ISLANDS
+
+    @property
+    def mixed(self) -> bool:
+        """True when compute is narrower than accumulation (the bf16 leg)."""
+        import jax.numpy as jnp
+
+        return jnp.dtype(self.compute_dtype) != jnp.dtype(self.accum_dtype)
+
+    def cast_compute(self, x):
+        """Narrow an array to the compute dtype (identity under fp32)."""
+        return x.astype(self.compute_dtype) if self.mixed else x
+
+    def wrap_apsp(self, apsp_fn=None):
+        """Wrap a resolved APSP callable so its (N, N, N) intermediates run
+        in the compute dtype.
+
+        `apsp_fn` follows the `ops.minplus.resolve_apsp` convention: None
+        means "the default XLA min-plus squaring".  Under the identity
+        policy the input is returned unchanged (None stays None, so callers'
+        `apsp_fn or apsp_minplus` defaulting still applies).  Under the
+        mixed policy the weight matrix is narrowed to bf16 BEFORE the
+        squaring — both (N, N, N) materializations downstream (the min-plus
+        broadcast and `next_hop_table`'s cost volume) then stay bf16, which
+        is the dominant bytes-per-step term — and the SP matrix is returned
+        bf16: its consumers re-accumulate in fp32 at the `decision_costs`
+        island (`env.offloading.offload_decide`).
+        """
+        if not self.mixed:
+            return apsp_fn
+        compute = self.compute_dtype
+
+        def bf16_apsp(w, _base=apsp_fn):
+            if _base is None:
+                from multihop_offload_tpu.env.apsp import apsp_minplus
+
+                _base = apsp_minplus
+            return _base(w.astype(compute))
+
+        return bf16_apsp
+
+
+def resolve_precision(
+    precision: Optional[str] = "fp32", base_dtype=None
+) -> PrecisionPolicy:
+    """Resolve the (`cfg.precision`, `cfg.dtype`) pair into a policy.
+
+    `precision` may also be an already-resolved PrecisionPolicy (returned
+    unchanged) or None (treated as "fp32") so call sites can accept either.
+    `base_dtype` is `cfg.jnp_dtype` (default float32).
+    """
+    if isinstance(precision, PrecisionPolicy):
+        return precision
+    import jax.numpy as jnp
+
+    precision = precision or "fp32"
+    if precision not in PRECISION_CHOICES:
+        raise ValueError(
+            f"unsupported precision '{precision}'; "
+            f"choose one of {sorted(PRECISION_CHOICES)}"
+        )
+    if precision == "auto":
+        import jax
+
+        precision = "bf16" if jax.default_backend() == "tpu" else "fp32"
+    base = jnp.dtype(base_dtype) if base_dtype is not None else jnp.dtype(
+        jnp.float32
+    )
+    if precision == "fp32":
+        # identity policy: everything in the base dtype (pre-policy
+        # behavior).  `jnp.dtype` returns numpy dtype objects (bfloat16 via
+        # ml_dtypes), so `base` doubles as the storage dtype directly.
+        return PrecisionPolicy(
+            name="fp32", param_dtype=base, compute_dtype=base,
+            accum_dtype=base, storage_dtype=base,
+        )
+    wide = jnp.promote_types(base, jnp.float32)
+    return PrecisionPolicy(
+        name="bf16",
+        param_dtype=wide,
+        compute_dtype=jnp.dtype(jnp.bfloat16),
+        accum_dtype=wide,
+        storage_dtype=jnp.dtype(jnp.bfloat16),  # numpy-compatible (ml_dtypes)
+    )

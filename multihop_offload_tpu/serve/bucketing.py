@@ -77,7 +77,7 @@ def pack_bucket(
     reqs: Sequence[OffloadRequest],
     pad: PadSpec,
     slots: int,
-    dtype=np.float32,
+    dtype=np.float32,  # fp32-island(storage default; the service passes its policy's storage dtype)
     hop_cache: Optional[Dict] = None,
 ) -> Tuple:
     """Pad + stack up to `slots` requests into one batched (Instance, JobSet).

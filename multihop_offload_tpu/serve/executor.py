@@ -40,18 +40,24 @@ class BucketExecutor:
         apsp_impl: str = "xla",
         fp_impl: str = "xla",
         prob: bool = False,
+        precision=None,
     ):
         from multihop_offload_tpu.ops.fixed_point import resolve_fixed_point
         from multihop_offload_tpu.ops.minplus import resolve_apsp
+        from multihop_offload_tpu.precision import resolve_precision
 
         self.model = model
         self.variables = variables
         self.buckets = buckets
         self.dispatch_count = 0
         self.loaded_step: Optional[int] = None
+        # mixed-precision policy (str | PrecisionPolicy | None): resolved
+        # once and baked into the per-bucket closures — no retrace on enable
+        self.precision = resolve_precision(precision)
         self._steps = {}
         for b, pad in enumerate(buckets.pads):
             apsp_fn, _ = resolve_apsp(apsp_impl, pad.n)
+            apsp_fn = self.precision.wrap_apsp(apsp_fn)
             fp_fn, _ = resolve_fixed_point(fp_impl, pad.l)
 
             def gnn_step(variables, binst, bjobs, keys,

@@ -58,20 +58,28 @@ class OffloadService:
         prob: bool = False,
         apsp_impl: str = "xla",
         fp_impl: str = "xla",
-        dtype=np.float32,
+        dtype=None,
+        precision=None,
         clock: Callable[[], float] = time.monotonic,
     ):
+        from multihop_offload_tpu.precision import resolve_precision
+
         if slots < 1 or queue_cap < 1:
             raise ValueError("slots and queue_cap must be >= 1")
+        # `dtype` is the BASE dtype (cfg.jnp_dtype); `precision` the policy
+        # knob (fp32 | bf16 | auto | PrecisionPolicy).  Request packing uses
+        # the policy's storage dtype (bf16 halves the per-tick transfer).
+        self.precision = resolve_precision(precision, dtype)
         self.executor = BucketExecutor(
             model, variables, buckets,
             apsp_impl=apsp_impl, fp_impl=fp_impl, prob=prob,
+            precision=self.precision,
         )
         self.buckets = buckets
         self.slots = slots
         self.queue_cap = queue_cap
         self.deadline_s = deadline_s
-        self.dtype = dtype
+        self.dtype = self.precision.storage_dtype
         self.clock = clock
         self.stats = ServingStats()
         self._queues: List[Deque[Tuple[OffloadRequest, float]]] = [

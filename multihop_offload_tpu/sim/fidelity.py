@@ -55,7 +55,7 @@ DEFAULT_UTILS = (0.1, 0.2, 0.3, 0.4, 0.5, 0.7, 0.85)
 
 
 def make_case(seed: int, topo, pad: PadSpec, num_jobs: int,
-              num_servers: int = 2, dtype=np.float32):
+              num_servers: int = 2, dtype=np.float32):  # fp32-island(storage default; callers pass the policy dtype)
     """One random connected BA case with a mid-load workload (rates are
     rescaled per utilization target afterwards)."""
     rng = np.random.default_rng(seed)

@@ -80,10 +80,20 @@ def make_policy(
     prob: bool = False,
     apsp_fn=None,
     fp_fn=None,
+    precision=None,
 ):
-    """Build the per-round policy function for `sim.runner.simulate`."""
+    """Build the per-round policy function for `sim.runner.simulate`.
+
+    `precision` (str | `precision.PrecisionPolicy` | None) narrows the APSP
+    inside the decision skeleton under the bf16 policy — resolved here at
+    build time and closed over, so the compiled sim program never retraces.
+    The decision read-back stays an fp32 island (`env.offloading`).
+    """
+    from multihop_offload_tpu.precision import resolve_precision
+
     if kind not in POLICY_KINDS:
         raise ValueError(f"unknown sim policy '{kind}'; one of {POLICY_KINDS}")
+    apsp_fn = resolve_precision(precision).wrap_apsp(apsp_fn)
 
     if kind == "local":
 

@@ -131,7 +131,7 @@ class FleetSim:
         rounds: int,
         slots_per_round: int,
         collect_schedule: bool = False,
-        dtype=jnp.float32,
+        dtype=jnp.float32,  # fp32-island(sim accumulators; precision only narrows the policy APSP)
     ):
         self.spec = spec
         self.rounds = rounds

@@ -102,7 +102,7 @@ class SimState:
     t: jnp.ndarray            # () int32 current slot
 
 
-def init_state(spec: SimSpec, dtype=jnp.float32) -> SimState:
+def init_state(spec: SimSpec, dtype=jnp.float32) -> SimState:  # fp32-island(delay accumulators: bf16 drops +1 past 256)
     q1 = spec.num_queues + 1
     c = spec.cap
     s = spec.num_streams

@@ -108,10 +108,14 @@ class DatasetCache:
             self._hop_cache[idx] = hop
         rates = sample_link_rates(rec.topo, rec.link_rates, rng=rng)
         # numpy leaves: jit transfers on call, and batch stacking ships one
-        # transfer per leaf instead of one per instance
+        # transfer per leaf instead of one per instance.  Storage dtype
+        # follows the precision policy (bf16 under the mixed policy halves
+        # host->device transfer and HBM residency; identical to
+        # cfg.jnp_dtype under the identity policy).
         return build_instance(
             rec.topo, rec.roles, rec.proc_bws, rates,
-            float(self.cfg.T), pad, dtype=self.cfg.jnp_dtype, hop=hop,
+            float(self.cfg.T), pad,
+            dtype=self.cfg.precision_policy.storage_dtype, hop=hop,
             device=False,
         )
 
@@ -124,13 +128,17 @@ def sample_jobsets(
     arrival_scale: float,
     ul: float = 100.0,
     dl: float = 1.0,
-    dtype=np.float32,
+    dtype=None,
 ) -> tuple:
     """`num_instances` independent workloads on one network, stacked for vmap.
 
     Per instance (`AdHoc_train.py:113-121`): jobs on a random 30-100% subset
     of mobile nodes, arrival rates U(0.1, 0.5) * arrival_scale.
+
+    `dtype` is the STORAGE dtype of the jobset arrays — pass the precision
+    policy's `storage_dtype` (the drivers do); None defaults to float32.
     """
+    dtype = np.float32 if dtype is None else dtype
     sets: List[JobSet] = []
     counts = []
     for _ in range(num_instances):

@@ -98,7 +98,11 @@ class _Harness:
             )
             jax.config.update("jax_enable_x64", True)
         self.data = DatasetCache.load(cfg, datapath)
-        self.model = make_model(cfg)
+        # mixed-precision policy: resolved ONCE here and baked into the
+        # jitted closures below (like apsp_impl/fp_impl) — never traced,
+        # so enabling bf16 causes zero retraces after steady
+        self.precision = cfg.precision_policy
+        self.model = make_model(cfg, policy=self.precision)
         pad = self.data.pad
         feats0 = jnp.zeros((pad.e, 4), cfg.jnp_dtype)
         support0 = jnp.zeros((pad.e, pad.e), cfg.jnp_dtype)
@@ -124,7 +128,7 @@ class _Harness:
                 js_p, _ = sample_jobsets(
                     self.data.records[fid], self.data.pad_of(fid), 1,
                     probe_rng, cfg.arrival_scale, ul=cfg.ul_data,
-                    dl=cfg.dl_data, dtype=cfg.jnp_dtype,
+                    dl=cfg.dl_data, dtype=self.precision.storage_dtype,
                 )
                 jb_p = jax.tree_util.tree_map(lambda x: x[0], js_p)
                 probes.append((build_ext_features(inst_p, jb_p),
@@ -188,6 +192,9 @@ class _Harness:
         from multihop_offload_tpu.ops.minplus import resolve_apsp
 
         apsp_fn, self.apsp_path = resolve_apsp(self.cfg.apsp_impl, self.data.pad.n)
+        # under the bf16 policy the APSP (the dominant bytes-per-step term)
+        # runs narrow; its consumers re-accumulate at the fp32 islands
+        apsp_fn = self.precision.wrap_apsp(apsp_fn)
         # interference-fixed-point kernel (`fp_impl` knob), resolved the same
         # way: None -> the XLA scan, else the Pallas VMEM-resident kernel
         # (custom_vjp, so both critics differentiate through it unchanged)
@@ -566,7 +573,7 @@ class Trainer(_Harness):
                 jobsets, counts = sample_jobsets(
                     rec, self.data.pad_of(fid), cfg.num_instances, self.rng,
                     cfg.arrival_scale, ul=cfg.ul_data, dl=cfg.dl_data,
-                    dtype=cfg.jnp_dtype,
+                    dtype=self.precision.storage_dtype,
                 )
             return (rec, inst, jobsets, counts), time.time() - t0
 
@@ -739,7 +746,7 @@ class Evaluator(_Harness):
             jobsets, counts = sample_jobsets(
                 rec, self.data.pad_of(fid), cfg.num_instances, frng,
                 cfg.arrival_scale, ul=cfg.ul_data, dl=cfg.dl_data,
-                dtype=cfg.jnp_dtype,
+                dtype=self.precision.storage_dtype,
             )
         return (rec, inst, jobsets, counts), time.time() - t0
 

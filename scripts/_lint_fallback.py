@@ -9,6 +9,16 @@ Approximates the ruff rule classes pyproject.toml selects:
   F841 locals assigned by a bare `name = ...` and never read are NOT
        checked (too alias-happy without scope analysis) — ruff covers it
 
+`--precision` runs the repo-specific mixed-precision rule instead (ruff has
+no equivalent, so `scripts/lint.sh` runs this mode on BOTH branches):
+hot-path modules (env/ models/ agent/ serve/ sim/) must not hardcode
+`jnp.float32` / `np.float32` — dtypes flow from `precision.PrecisionPolicy`.
+A deliberate fp32 island is waived per line with an explicit reason:
+
+    x = y.astype(jnp.float32)  # fp32-island(M/M/1 denominator 1-rho)
+
+`precision.py` itself (the policy definition) is exempt.
+
 Zero third-party imports, stdlib-only, so the gate runs anywhere the repo
 does.  Exit status: 0 clean, 1 findings, 2 usage error.
 """
@@ -17,7 +27,12 @@ from __future__ import annotations
 
 import ast
 import os
+import re
 import sys
+
+PRECISION_HOT_DIRS = ("env", "models", "agent", "serve", "sim")
+_F32_LITERAL = re.compile(r"\b(?:jnp|np|numpy)\.float32\b")
+_WAIVER = "# fp32-island("
 
 
 def _py_files(roots):
@@ -122,11 +137,41 @@ def check_file(path: str):
     return findings
 
 
+def check_precision_file(path: str):
+    """MP001: hardcoded float32 literal in a hot-path module (see module
+    docstring).  Waive a deliberate island with `# fp32-island(<why>)`."""
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    findings = []
+    for lineno, line in enumerate(src.splitlines(), 1):
+        code = line.split("#", 1)[0]
+        if not _F32_LITERAL.search(code):
+            continue
+        if _WAIVER in line or "# noqa" in line:
+            continue
+        findings.append((lineno, (
+            "MP001 hardcoded float32 in hot path — take the dtype from "
+            "precision.PrecisionPolicy, or waive with '# fp32-island(<why>)'"
+        )))
+    return findings
+
+
+def precision_roots(pkg="multihop_offload_tpu"):
+    return [os.path.join(pkg, d) for d in PRECISION_HOT_DIRS]
+
+
 def main(argv):
+    check = check_file
+    if argv and argv[0] == "--precision":
+        check = check_precision_file
+        argv = argv[1:] or precision_roots()
     roots = argv or ["multihop_offload_tpu"]
     total = 0
     for path in sorted(_py_files(roots)):
-        for lineno, msg in sorted(check_file(path)):
+        if check is check_precision_file and \
+                os.path.basename(path) == "precision.py":
+            continue
+        for lineno, msg in sorted(check(path)):
             print(f"{path}:{lineno}: {msg}")
             total += 1
     if total:

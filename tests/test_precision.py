@@ -1,0 +1,248 @@
+"""Mixed-precision policy: bf16 hot path vs fp32 parity, fp32 islands.
+
+Tier-1 (CPU) gate for the `cfg.precision` knob: the bf16 policy must make
+the SAME offloading decisions as fp32 (>= 99% agreement) with per-method
+job-total deltas inside the documented tolerance, while the ill-conditioned
+steps (interference fixed point, delay reductions, decision read-back)
+provably stay fp32.  A float64 reference column (conftest enables x64)
+bounds how much of the observed delta is fp32's own rounding vs bf16's.
+
+Tolerances: bf16 carries ~8 mantissa bits (relative step ~2^-8 = 0.4%);
+after the M/M/1 amplification through `1/(mu - lambda)` at the moderate
+loads used here, per-job totals land within a few percent.  The committed
+gate (`benchmarks/precision_ab.json`) uses the same thresholds.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from multihop_offload_tpu.env.policies import baseline_policy, local_policy
+from multihop_offload_tpu.env.queueing import (
+    interference_fixed_point,
+    interference_fixed_point_raw,
+)
+from multihop_offload_tpu.graphs import generators
+from multihop_offload_tpu.graphs.instance import PadSpec
+from multihop_offload_tpu.graphs.topology import build_topology
+from multihop_offload_tpu.models.chebconv import chebyshev_support
+from multihop_offload_tpu.precision import (
+    FP32_ISLANDS,
+    PrecisionPolicy,
+    island_dtype,
+    resolve_precision,
+)
+from multihop_offload_tpu.sim.fidelity import make_case
+
+AGREEMENT_FLOOR = 0.99   # offload decisions: bf16 vs fp32
+TAU_RTOL_BF16 = 0.05     # per-method mean job-total relative delta vs fp32
+TAU_RTOL_FP32 = 1e-3     # fp32 vs float64 reference (sanity column)
+
+
+def _case(seed, dtype, n_nodes=16, num_jobs=8):
+    topo = build_topology(generators.barabasi_albert(n_nodes, seed=seed)[0])
+    pad = PadSpec(n=16, l=-(-topo.num_links // 8) * 8, s=8, j=num_jobs)
+    return make_case(seed, topo, pad, num_jobs, dtype=dtype)
+
+
+def _run(policy, inst, jobs, key):
+    apsp_fn = policy.wrap_apsp(None)
+    out_b = baseline_policy(inst, jobs, key, apsp_fn=apsp_fn)
+    out_l = local_policy(inst, jobs)
+    return {"baseline": out_b, "local": out_l}
+
+
+def _mean_tau(outcome, jobs):
+    m = np.asarray(jobs.mask)
+    return float(np.asarray(outcome.job_total, np.float64)[m].mean())
+
+
+# ---- policy resolution -----------------------------------------------------
+
+
+def test_resolve_identity_fp32():
+    pol = resolve_precision("fp32", jnp.float32)
+    assert not pol.mixed
+    assert jnp.dtype(pol.param_dtype) == jnp.dtype(jnp.float32)
+    assert jnp.dtype(pol.storage_dtype) == jnp.dtype(jnp.float32)
+    # identity policy is a no-op wrapper: the resolved apsp_fn (None for the
+    # XLA default) must pass through unchanged so `apsp_fn or apsp_minplus`
+    # defaulting still applies downstream
+    assert pol.wrap_apsp(None) is None
+    f = lambda w: w  # noqa: E731
+    assert pol.wrap_apsp(f) is f
+    # resolving an already-resolved policy is idempotent
+    assert resolve_precision(pol) is pol
+    # None means fp32 (the default until the A/B gates pass)
+    assert not resolve_precision(None).mixed
+
+
+def test_resolve_bf16():
+    pol = resolve_precision("bf16", jnp.float32)
+    assert pol.mixed
+    assert jnp.dtype(pol.compute_dtype) == jnp.dtype(jnp.bfloat16)
+    assert jnp.dtype(pol.param_dtype) == jnp.dtype(jnp.float32)
+    assert jnp.dtype(pol.accum_dtype) == jnp.dtype(jnp.float32)
+    # storage dtype must be numpy-compatible (host-side packing uses it)
+    z = np.zeros((3,), pol.storage_dtype)
+    assert z.dtype == jnp.dtype(jnp.bfloat16)
+    assert pol.islands == FP32_ISLANDS
+
+
+def test_resolve_auto_off_tpu():
+    # this suite runs on CPU (conftest pins the platform): auto -> fp32
+    assert jax.default_backend() != "tpu"
+    assert resolve_precision("auto").name == "fp32"
+
+
+def test_island_dtype_floor_and_promotion():
+    assert island_dtype(jnp.bfloat16) == jnp.dtype(jnp.float32)
+    assert island_dtype(jnp.float32, jnp.bfloat16) == jnp.dtype(jnp.float32)
+    # x64 is on in tests: a float64 operand keeps the island at float64
+    assert island_dtype(jnp.float64) == jnp.dtype(jnp.float64)
+
+
+# ---- fp32 islands ----------------------------------------------------------
+
+
+def test_fixed_point_island_holds_under_bf16():
+    """bf16 operands in, >= fp32 fixed point out, matching the fp32 run."""
+    inst, jobs = _case(0, np.float32)
+    lam = (0.3 * np.asarray(inst.link_rates, np.float32)
+           * np.asarray(inst.link_mask, np.float32))
+    mu32 = interference_fixed_point(inst, jnp.asarray(lam, jnp.float32))
+
+    bf = jnp.bfloat16
+    inst16 = inst.replace(
+        adj_conflict=inst.adj_conflict.astype(bf),
+        link_rates=inst.link_rates.astype(bf),
+        cf_degs=inst.cf_degs.astype(bf),
+    )
+    mu16 = interference_fixed_point(inst16, jnp.asarray(lam).astype(bf))
+    assert mu16.dtype == jnp.dtype(jnp.float32)
+    # operands were rounded to bf16 once (~0.4% each) but the ITERATION ran
+    # wide: the result tracks the fp32 run at input-rounding error, not at
+    # the compounded error a bf16 iteration would show
+    np.testing.assert_allclose(
+        np.asarray(mu16), np.asarray(mu32), rtol=2e-2
+    )
+
+    # contrast: iterating the raw core natively in bf16 (what the island
+    # prevents) visibly drifts from the wide run
+    mu_native = interference_fixed_point_raw(
+        inst16.adj_conflict, inst16.link_rates, inst16.cf_degs,
+        jnp.asarray(lam).astype(bf),
+    )
+    assert mu_native.dtype == jnp.dtype(bf)
+
+
+def test_laplacian_constants_survive_bf16_adjacency():
+    """`chebyshev_support` on a bf16 adjacency computes wide internally and
+    only narrows on the way out — the eye/degree constants never degrade."""
+    inst, _ = _case(1, np.float32)
+    adj32 = inst.adj.astype(jnp.float32)
+    mask = jnp.ones((inst.num_pad_nodes,), bool)
+    sup32 = chebyshev_support(adj32, mask)
+    sup16 = chebyshev_support(adj32.astype(jnp.bfloat16), mask)
+    assert sup16.dtype == jnp.dtype(jnp.bfloat16)
+    np.testing.assert_allclose(
+        np.asarray(sup16, np.float32), np.asarray(sup32), atol=4e-3
+    )
+    # explicit output-dtype override (the policy's compute dtype)
+    sup_cast = chebyshev_support(adj32, mask, dtype=jnp.bfloat16)
+    assert sup_cast.dtype == jnp.dtype(jnp.bfloat16)
+
+
+# ---- fp32 vs bf16 end-to-end parity ---------------------------------------
+
+
+def _parity_legs(seeds=(0, 1, 2, 3)):
+    pol32 = resolve_precision("fp32", jnp.float32)
+    pol16 = resolve_precision("bf16", jnp.float32)
+    legs = []
+    for seed in seeds:
+        key = jax.random.PRNGKey(seed)
+        inst32, jobs32 = _case(seed, np.float32)
+        inst16, jobs16 = _case(seed, pol16.storage_dtype)
+        inst64, jobs64 = _case(seed, np.float64)
+        legs.append({
+            "fp32": (_run(pol32, inst32, jobs32, key), jobs32),
+            "bf16": (_run(pol16, inst16, jobs16, key), jobs16),
+            "fp64": (_run(pol32, inst64, jobs64, key), jobs64),
+        })
+    return legs
+
+
+@pytest.fixture(scope="module")
+def parity_legs():
+    return _parity_legs()
+
+
+def test_decision_agreement_bf16(parity_legs):
+    agree = total = 0
+    for leg in parity_legs:
+        out32, jobs = leg["fp32"]
+        out16, _ = leg["bf16"]
+        m = np.asarray(jobs.mask)
+        d32 = np.asarray(out32["baseline"].decision.dst)[m]
+        d16 = np.asarray(out16["baseline"].decision.dst)[m]
+        agree += int((d32 == d16).sum())
+        total += int(m.sum())
+    assert total >= 16
+    assert agree / total >= AGREEMENT_FLOOR, f"{agree}/{total} decisions agree"
+
+
+def test_job_totals_within_tolerance(parity_legs):
+    for leg in parity_legs:
+        out32, jobs = leg["fp32"]
+        out16, jobs16 = leg["bf16"]
+        out64, jobs64 = leg["fp64"]
+        for method in ("baseline", "local"):
+            t32 = _mean_tau(out32[method], jobs)
+            t16 = _mean_tau(out16[method], jobs16)
+            t64 = _mean_tau(out64[method], jobs64)
+            assert abs(t16 - t32) / t32 <= TAU_RTOL_BF16, (
+                f"{method}: bf16 tau {t16} vs fp32 {t32}"
+            )
+            # sanity column: fp32 itself sits tight on the fp64 reference,
+            # so the bf16 delta above is bf16's, not fp32's
+            assert abs(t32 - t64) / t64 <= TAU_RTOL_FP32, (
+                f"{method}: fp32 tau {t32} vs fp64 {t64}"
+            )
+
+
+def test_delay_outputs_stay_wide_under_bf16(parity_legs):
+    """The delay_reduction island: bf16 storage in, fp32 job totals out."""
+    for leg in parity_legs:
+        out16, _ = leg["bf16"]
+        for method in ("baseline", "local"):
+            d = out16[method].delays
+            for field in (d.job_total, d.link_lambda, d.link_mu):
+                assert jnp.dtype(field.dtype) == jnp.dtype(jnp.float32), (
+                    f"{method}: {field.dtype} leaked past the island"
+                )
+
+
+def test_policy_is_static_no_retrace():
+    """The policy is resolved at build time and closed over — flipping it
+    never shows up as a traced value (PrecisionPolicy is not a pytree leaf
+    the jitted programs see)."""
+    pol = resolve_precision("bf16", jnp.float32)
+    assert isinstance(pol, PrecisionPolicy)
+    traces = {"n": 0}
+
+    def apsp_counting(w):
+        traces["n"] += 1
+        from multihop_offload_tpu.env.apsp import apsp_minplus
+
+        return apsp_minplus(w)
+
+    wrapped = pol.wrap_apsp(apsp_counting)
+    inst, jobs = _case(2, pol.storage_dtype)
+    f = jax.jit(lambda i, j, k: baseline_policy(i, j, k, apsp_fn=wrapped))
+    key = jax.random.PRNGKey(0)
+    f(inst, jobs, key)
+    first = traces["n"]
+    f(inst, jobs, jax.random.PRNGKey(1))
+    assert traces["n"] == first, "jitted policy retraced on a steady call"
