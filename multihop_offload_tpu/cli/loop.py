@@ -1,0 +1,363 @@
+"""Continual-learning flywheel entry point (`mho-loop`).
+
+    mho-loop --smoke                     # <90 s CPU end-to-end self-check
+    mho-loop --obs_log=runs/loop.jsonl --loop_capture_sample=0.1 \
+        --loop_cycles=4 --serve_sizes=16,24
+
+One cycle closes serve -> train -> serve: drive traffic through the
+service with experience capture on, re-fit the policy on the captured
+outcomes (`loop.refit`), A/B the candidate against the serving champion
+in the packet simulator on a held-out slice (`loop.validate`), and
+promote it through the no-retrace hot-reload path — with automatic
+rollback if the sim gates fail or the post-promotion measured tau
+regresses (`loop.promote`).  The smoke run forces a rotation-sized run
+log, a winning candidate (tiny LR: the machinery is under test, not the
+learning), and an injected post-promotion regression, so both the
+promotion and the rollback paths execute in one run; the record lands at
+`benchmarks/loop_smoke.json`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+
+from multihop_offload_tpu.config import Config, build_parser
+
+
+def _bootstrap_champion(cfg: Config, service) -> int:
+    """Ensure a serving checkpoint exists: a flywheel needs a champion to
+    measure against, so a virgin model dir gets the service's own (fresh
+    init or restored) weights saved as step 1, `source="offline"`."""
+    import jax
+
+    from multihop_offload_tpu.train import checkpoints as ckpt_lib
+
+    directory = os.path.join(cfg.model_dir(), "orbax")
+    step = ckpt_lib.latest_step(directory)
+    if step is None:
+        host = jax.tree_util.tree_map(
+            np.asarray, service.executor.variables["params"]
+        )
+        ckpt_lib.save_checkpoint(
+            directory, 1, {"params": host},
+            lineage=ckpt_lib.make_lineage(
+                "offline", cfg=cfg, extra={"bootstrap": True}
+            ),
+        )
+        step = 1
+    service.hot_reload(cfg.model_dir())
+    return step
+
+
+def _capture_window(cfg: Config, service, pool, count: int, id_offset: int):
+    """Drive `count` synthetic requests through submit/tick (closed loop,
+    `cli.serve` semantics) with capture on; returns (responses, next_id)."""
+    from multihop_offload_tpu.serve.workload import request_stream
+
+    pending = list(request_stream(
+        pool, count, seed=cfg.seed + 1 + id_offset,
+        arrival_scale=cfg.arrival_scale, ul=cfg.ul_data, dl=cfg.dl_data,
+        t_max=float(cfg.T), id_offset=id_offset,
+    ))
+    pending.reverse()
+    responses = []
+    while pending or service.queue_depth:
+        while pending:
+            req = pending.pop()
+            if not service.submit(req):
+                if service.buckets.bucket_for(*req.sizes) is not None:
+                    pending.append(req)
+                break
+        responses.extend(service.tick())
+    return responses, id_offset + count
+
+
+def _window_tau(responses):
+    """Measured mean tau of a window's GNN-served responses (None when the
+    window had none — e.g. fully degraded)."""
+    taus = [
+        float(np.asarray(r.job_total).mean())
+        for r in responses if r.served_by == "gnn" and r.job_total.size
+    ]
+    return float(np.mean(taus)) if taus else None
+
+
+def run_cycle(
+    cfg: Config,
+    model,
+    service,
+    pool,
+    controller,
+    id_offset: int,
+    cycle: int = 0,
+    inject_regression: bool = False,
+    steady_after_validate: bool = False,
+):
+    """One full flywheel cycle; returns (record, next_id_offset)."""
+    from multihop_offload_tpu.loop.experience import (
+        read_outcomes,
+        split_holdout,
+    )
+    from multihop_offload_tpu.loop.promote import monitor_ok
+    from multihop_offload_tpu.loop.refit import refit_and_save
+    from multihop_offload_tpu.loop.validate import ab_compare, apply_gates
+    from multihop_offload_tpu.obs import jaxhooks
+    from multihop_offload_tpu.obs.registry import registry as obs_registry
+
+    record: dict = {"cycle": cycle}
+
+    # ---- capture -----------------------------------------------------------
+    controller.transition("capturing", cycle=cycle)
+    responses, id_offset = _capture_window(
+        cfg, service, pool, cfg.loop_capture_requests, id_offset
+    )
+    pre_tau = _window_tau(responses)
+    outcomes = read_outcomes(cfg.obs_log)
+    record.update(served=len(responses), outcomes=len(outcomes),
+                  pre_tau=pre_tau)
+    train, hold = split_holdout(outcomes, cfg.loop_holdout_frac)
+    if not train or not hold:
+        controller.transition("idle", reason="insufficient experience")
+        record["skipped"] = "insufficient experience"
+        return record, id_offset
+
+    # ---- refit -------------------------------------------------------------
+    controller.transition("refitting", train=len(train), holdout=len(hold))
+    champion_vars = {"params": service.executor.variables["params"]}
+    cand_vars, cand_step, refit_info = refit_and_save(
+        model, champion_vars, train, cfg,
+        parent_step=service.executor.loaded_step, seed=cfg.seed + cycle,
+    )
+    record["refit"] = refit_info
+    record["candidate_step"] = cand_step
+
+    # ---- validate ----------------------------------------------------------
+    controller.transition("validating")
+    scores = ab_compare(
+        model, champion_vars, cand_vars, hold,
+        rounds=cfg.loop_sim_rounds, slots_per_round=cfg.loop_sim_slots,
+        cap=cfg.sim_cap, margin=cfg.sim_margin, seed=cfg.seed,
+        round_to=cfg.round_to, precision=cfg.precision_policy,
+        dtype=cfg.jnp_dtype,
+    )
+    ok, reasons = apply_gates(
+        scores["champion"], scores["candidate"],
+        cfg.loop_gate_delivered_drop, cfg.loop_gate_tau_ratio,
+    )
+    record["ab"] = scores
+    record["gates"] = {
+        "ok": ok, "reasons": reasons,
+        "max_delivered_drop": cfg.loop_gate_delivered_drop,
+        "max_tau_ratio": cfg.loop_gate_tau_ratio,
+    }
+    if steady_after_validate:
+        # everything the rest of the cycle runs (serve ticks, orbax
+        # save/restore, hot-reload) has now compiled; promotion and
+        # rollback must not trace anything new
+        jaxhooks.mark_steady()
+    if not ok:
+        controller.reject("; ".join(reasons), candidate_step=cand_step)
+        return record, id_offset
+
+    # ---- promote -----------------------------------------------------------
+    from multihop_offload_tpu.train import checkpoints as ckpt_lib
+
+    step = controller.promote(
+        service, cand_vars,
+        lineage=ckpt_lib.make_lineage(
+            "refit", parent_step=service.executor.loaded_step,
+            parent_dir=controller.directory, cfg=cfg,
+            extra={"candidate_step": cand_step},
+        ),
+        candidate_step=cand_step,
+    )
+    record["promoted_step"] = step
+    if step is None:
+        return record, id_offset
+
+    # ---- monitor -----------------------------------------------------------
+    controller.transition("monitoring", step=step)
+    monitor_n = max(cfg.loop_capture_requests // 2, 4)
+    responses_b, id_offset = _capture_window(
+        cfg, service, pool, monitor_n, id_offset
+    )
+    post_tau = _window_tau(responses_b)
+    record["post_tau_measured"] = post_tau
+    if inject_regression:
+        # forced regression: exercise the rollback path deterministically
+        # (the measured tau of a 2-step refit won't reliably regress)
+        post_tau = (pre_tau or 1.0) * cfg.loop_monitor_regression * 10.0
+        record["post_tau_injected"] = post_tau
+    if monitor_ok(pre_tau, post_tau, cfg.loop_monitor_regression):
+        controller.transition("idle", step=step)
+    else:
+        rb = controller.rollback(
+            service, champion_vars,
+            reason=("injected regression" if inject_regression
+                    else f"measured tau {post_tau} vs pre {pre_tau}"),
+            failed_step=step,
+        )
+        record["rollback_step"] = rb
+        # the rolled-back service must keep serving
+        responses_c, id_offset = _capture_window(
+            cfg, service, pool, max(monitor_n // 2, 4), id_offset
+        )
+        record["post_rollback_served"] = len(responses_c)
+        record["post_rollback_tau"] = _window_tau(responses_c)
+    reg = obs_registry()
+    record["counters"] = {
+        "promotions": int(reg.counter("mho_loop_promotions_total").total()),
+        "rollbacks": int(reg.counter("mho_loop_rollbacks_total").total()),
+        "rejections": int(reg.counter("mho_loop_rejections_total").total()),
+    }
+    return record, id_offset
+
+
+def run_loop(cfg: Config, inject_regression: bool = False,
+             steady_after_validate: bool = False) -> dict:
+    """Build the service + controller and run `cfg.loop_cycles` cycles."""
+    from multihop_offload_tpu.cli.serve import build_service
+    from multihop_offload_tpu.loop.promote import PromotionController
+    from multihop_offload_tpu.models import make_model
+    from multihop_offload_tpu.obs import jaxhooks
+    from multihop_offload_tpu.obs.events import segment_paths
+
+    service, pool = build_service(cfg)
+    model = make_model(cfg)
+    controller = PromotionController(cfg.model_dir())
+    champion_step = _bootstrap_champion(cfg, service)
+
+    cycles = []
+    id_offset = 0
+    for c in range(max(cfg.loop_cycles, 1)):
+        rec, id_offset = run_cycle(
+            cfg, model, service, pool, controller, id_offset, cycle=c,
+            inject_regression=inject_regression,
+            steady_after_validate=steady_after_validate and c == 0,
+        )
+        cycles.append(rec)
+    return {
+        "champion_bootstrap_step": champion_step,
+        "cycles": cycles,
+        "states": [h["state"] for h in controller.history],
+        "final_loaded_step": service.executor.loaded_step,
+        "final_lineage": service.executor.loaded_lineage,
+        "log_segments": len(segment_paths(cfg.obs_log)) if cfg.obs_log else 0,
+        "unexpected_retraces": jaxhooks.unexpected_retraces(),
+    }
+
+
+def smoke_config(cfg: Config, tmp: str) -> Config:
+    """The tiny end-to-end configuration: one bucket, rotation-sized log
+    segments, full capture, 2 refit steps, near-zero LR (so the candidate
+    ties the champion and the promotion gates pass deterministically)."""
+    return dataclasses.replace(
+        cfg,
+        serve_sizes="10", serve_buckets=1, serve_slots=4,
+        serve_queue_cap=64, serve_deadline_s=60.0,
+        model_root=os.path.join(tmp, "model"),
+        obs_log=os.path.join(tmp, "loop_run.jsonl"),
+        obs_log_max_bytes=8192,
+        loop_capture_sample=1.0, loop_capture_requests=24,
+        loop_refit_steps=2, loop_refit_slots=2, loop_holdout_frac=0.25,
+        loop_sim_rounds=2, loop_sim_slots=120, loop_cycles=1,
+        sim_cap=64, sim_margin=5.0,
+        learning_rate=1e-6, learning_decay=1.0,
+    )
+
+
+def run_smoke(cfg: Config) -> dict:
+    """capture (>= 2 rotated segments) -> refit 2 steps -> validate ->
+    promote -> forced regression -> rollback, asserting the flywheel
+    invariants along the way."""
+    import tempfile
+
+    from multihop_offload_tpu import obs
+
+    with tempfile.TemporaryDirectory(prefix="mho_loop_smoke_") as tmp:
+        scfg = smoke_config(cfg, tmp)
+        runlog = obs.start_run(scfg, role="loop")
+        try:
+            out = run_loop(
+                scfg, inject_regression=True, steady_after_validate=True
+            )
+        finally:
+            obs.finish_run(runlog)
+
+    cyc = out["cycles"][0]
+    checks = {
+        "log_rotated": out["log_segments"] >= 2,
+        "gates_passed": bool(cyc.get("gates", {}).get("ok")),
+        "promoted": cyc.get("promoted_step") is not None,
+        "rolled_back": cyc.get("rollback_step") is not None,
+        "serving_after_rollback": cyc.get("post_rollback_served", 0) > 0,
+        "rollback_lineage": (out.get("final_lineage") or {}).get("source")
+        == "rollback",
+        "counters_promotions": cyc.get("counters", {}).get("promotions", 0) >= 1,
+        "counters_rollbacks": cyc.get("counters", {}).get("rollbacks", 0) >= 1,
+        "zero_unexpected_retraces": out["unexpected_retraces"] == 0,
+    }
+    out["checks"] = checks
+    out["ok"] = all(checks.values())
+    assert out["ok"], f"loop smoke failed: {checks}"
+    return out
+
+
+def write_record(record: dict, path: str) -> None:
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(record, f, indent=1, default=str)
+        f.write("\n")
+
+
+def main(argv=None):
+    from multihop_offload_tpu import obs
+    from multihop_offload_tpu.utils.platform import apply_platform_env
+
+    p = build_parser()
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny end-to-end flywheel self-check (<90 s CPU); "
+                        "writes benchmarks/loop_smoke.json")
+    ns = p.parse_args(argv)
+    mode_smoke = ns.smoke
+    cfg = Config(**{f.name: getattr(ns, f.name)
+                    for f in dataclasses.fields(Config)})
+    apply_platform_env()
+
+    if mode_smoke:
+        out = run_smoke(cfg)
+        path = cfg.loop_out or "benchmarks/loop_smoke.json"
+        write_record(out, path)
+        print(f"loop smoke record written to {path}")
+        print(json.dumps(out["checks"], indent=2))
+        return 0
+
+    # run mode: the flywheel needs a log to capture into and a nonzero
+    # sampling rate to have any experience to learn from
+    if not cfg.obs_log:
+        cfg = dataclasses.replace(cfg, obs_log="runs/loop_run.jsonl")
+        print(f"--obs_log unset; capturing to {cfg.obs_log}")
+    if cfg.loop_capture_sample <= 0.0:
+        cfg = dataclasses.replace(cfg, loop_capture_sample=1.0)
+        print("--loop_capture_sample unset; capturing every request")
+    runlog = obs.start_run(cfg, role="loop")
+    try:
+        out = run_loop(cfg)
+    finally:
+        obs.finish_run(runlog)
+    if cfg.loop_out:
+        write_record(out, cfg.loop_out)
+    print(json.dumps(out, indent=2, default=str))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -49,6 +49,7 @@ def build_service(cfg: Config, pool=None):
         deadline_s=cfg.serve_deadline_s, seed=cfg.seed, prob=cfg.prob,
         apsp_impl=cfg.apsp_impl, fp_impl=cfg.fp_impl,
         dtype=cfg.jnp_dtype, precision=cfg.precision_policy,
+        capture_sample=cfg.loop_capture_sample,
     )
     loaded = service.hot_reload(cfg.model_dir())
     print("serving with "

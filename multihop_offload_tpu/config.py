@@ -177,6 +177,37 @@ class Config:
     obs_prom: str = ""             # write the final metric-registry snapshot
     #                                as Prometheus text exposition to this
     #                                path at loop exit ("" = disabled)
+    obs_log_max_bytes: int = 0     # size-cap per JSONL segment: when the
+    #                                active run log would grow past this, it
+    #                                is rotated to `<path>.NNNN` and a fresh
+    #                                segment opened (0 = never rotate).  The
+    #                                continual-learning flywheel tails serve
+    #                                logs forever, so long-running services
+    #                                should set this; `obs.events.read_events`
+    #                                spans segment boundaries transparently
+    # ---- continual learning (loop/ subsystem; cli.loop) --------------------
+    loop_capture_sample: float = 0.0   # fraction of served requests emitted
+    #                                as `outcome` experience events through
+    #                                the active run log (0 = capture off);
+    #                                sampling is deterministic by request id
+    loop_capture_requests: int = 48    # requests per capture window (cli.loop
+    #                                drives its own synthetic traffic)
+    loop_refit_steps: int = 20     # fine-tuning steps per background re-fit
+    loop_refit_slots: int = 4      # experience outcomes batched per refit step
+    loop_holdout_frac: float = 0.25    # outcome fraction held out of the
+    #                                refit and replayed in sim for the A/B
+    loop_gate_delivered_drop: float = 0.02  # promotion gate: candidate sim
+    #                                delivered ratio may trail the champion
+    #                                by at most this (absolute)
+    loop_gate_tau_ratio: float = 1.10  # promotion gate: candidate mean sim
+    #                                packet delay at most champion * this
+    loop_monitor_regression: float = 1.5   # post-promotion watchdog: measured
+    #                                tau beyond pre-promotion * this triggers
+    #                                automatic rollback
+    loop_cycles: int = 1           # flywheel cycles for `mho-loop run`
+    loop_sim_rounds: int = 2       # A/B validation sim: policy rounds
+    loop_sim_slots: int = 200      # A/B validation sim: slots per round
+    loop_out: str = ""             # write the cycle/smoke JSON record here
 
     @property
     def jnp_dtype(self):

@@ -1,0 +1,14 @@
+"""Continual-learning flywheel: serve -> train -> serve, closed.
+
+The service logs per-request outcomes (`serve.service` capture ->
+`obs.events` "outcome" rows); `experience` turns that stream back into
+replay batches; `refit` fine-tunes the policy on them in the background;
+`validate` replays a held-out slice of the logged workload through the
+packet simulator for champion vs candidate; `promote` drives the
+state machine capture -> refit -> validate -> promote-via-hot-reload ->
+monitor, with automatic rollback.  Entry point: `cli.loop` (`mho-loop`).
+
+Deliberately import-light: submodules import serve/sim/train/agent pieces
+directly, and serve.service imports `loop.experience` — keeping this
+package namespace empty avoids the cycle.
+"""

@@ -1,0 +1,148 @@
+"""Promotion controller: the flywheel's state machine, with rollback.
+
+States: idle -> capturing -> refitting -> validating -> {promoted |
+rejected} -> monitoring -> {ok -> idle | rolled_back}.  Transitions are
+host-side bookkeeping; the two state-changing actions are:
+
+- `promote`: pre-validate the candidate's param signature against the
+  LIVE serving tree (`serve.executor.param_signature` — a mismatched tree
+  must reject the promotion here, never fail mid-tick), save it into the
+  serving orbax tree at a fresh monotone step with its lineage, and swap
+  it in through the service's no-retrace hot-reload path.
+- `rollback`: re-pin the pre-promotion champion.  Orbax keeps the FIRST
+  save of any step id, so rollback never "goes back" to an old step — it
+  re-saves the champion snapshot at `latest + 1` (`source="rollback"`
+  lineage pointing at the failed candidate) and hot-reloads.  The step
+  counter stays monotone, the weights return.
+
+Every transition lands in the run log (`loop_state` events; `promotion` /
+`rollback` / `rejection` for the decisions) and the `mho_loop_*` counters,
+so `mho-obs` can render a flywheel run and Prometheus can alert on
+rollback rate.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, List, Optional
+
+import jax
+import numpy as np
+
+from multihop_offload_tpu.obs import events as obs_events
+from multihop_offload_tpu.obs.registry import registry as obs_registry
+from multihop_offload_tpu.serve.executor import param_signature
+from multihop_offload_tpu.train import checkpoints as ckpt_lib
+
+STATES = (
+    "idle", "capturing", "refitting", "validating",
+    "promoted", "rejected", "monitoring", "rolled_back",
+)
+
+
+class PromotionController:
+    """Drives candidate weights into (and back out of) the serving tree."""
+
+    def __init__(self, model_dir: str, which: str = "orbax"):
+        self.model_dir = model_dir
+        self.which = which
+        self.directory = os.path.join(model_dir, which)
+        self.state = "idle"
+        self.history: List[dict] = []
+
+    # ---- state bookkeeping -------------------------------------------------
+
+    def transition(self, state: str, **fields) -> None:
+        if state not in STATES:
+            raise ValueError(f"unknown loop state '{state}'; one of {STATES}")
+        self.state = state
+        rec = {"state": state, **fields}
+        self.history.append(rec)
+        obs_events.emit("loop_state", **rec)
+        obs_registry().counter(
+            "mho_loop_transitions_total", "flywheel state transitions"
+        ).inc(state=state)
+
+    def _next_step(self) -> int:
+        return (ckpt_lib.latest_step(self.directory) or 0) + 1
+
+    # ---- the two weight-moving actions -------------------------------------
+
+    def promote(
+        self,
+        service,
+        candidate_variables: Any,
+        lineage: Optional[dict] = None,
+        candidate_step: Optional[int] = None,
+    ) -> Optional[int]:
+        """Validated candidate -> serving tree -> hot-reload.
+
+        Returns the serving step it landed at, or None when the candidate
+        was structurally rejected (wrong tree/shape/dtype signature — the
+        service keeps serving the champion untouched)."""
+        live = service.executor.variables["params"]
+        cand = candidate_variables["params"]
+        if param_signature(cand) != param_signature(live):
+            self.reject("param signature mismatch against live tree",
+                        candidate_step=candidate_step)
+            return None
+        step = self._next_step()
+        host = jax.tree_util.tree_map(np.asarray, candidate_variables)
+        ckpt_lib.save_checkpoint(
+            self.directory, step, {"params": host["params"]},
+            lineage=lineage if lineage is not None
+            else ckpt_lib.make_lineage("refit", parent_step=candidate_step),
+        )
+        loaded = service.hot_reload(self.model_dir, which=self.which)
+        obs_registry().counter(
+            "mho_loop_promotions_total", "candidates promoted to serving"
+        ).inc()
+        obs_events.emit("promotion", step=step, loaded=loaded,
+                        candidate_step=candidate_step)
+        self.transition("promoted", step=step)
+        return step
+
+    def reject(self, reason: str, candidate_step: Optional[int] = None) -> None:
+        """Candidate refused before touching the serving tree."""
+        obs_registry().counter(
+            "mho_loop_rejections_total", "candidates refused promotion"
+        ).inc()
+        obs_events.emit("rejection", reason=reason,
+                        candidate_step=candidate_step)
+        self.transition("rejected", reason=reason)
+
+    def rollback(self, service, champion_variables: Any, reason: str,
+                 failed_step: Optional[int] = None) -> int:
+        """Re-pin the champion snapshot at a fresh monotone step."""
+        step = self._next_step()
+        host = jax.tree_util.tree_map(np.asarray, champion_variables)
+        ckpt_lib.save_checkpoint(
+            self.directory, step, {"params": host["params"]},
+            lineage=ckpt_lib.make_lineage(
+                "rollback", parent_step=failed_step,
+                parent_dir=self.directory,
+                extra={"reason": reason},
+            ),
+        )
+        loaded = service.hot_reload(self.model_dir, which=self.which)
+        obs_registry().counter(
+            "mho_loop_rollbacks_total", "promotions rolled back"
+        ).inc()
+        obs_events.emit("rollback", step=step, loaded=loaded,
+                        reason=reason, failed_step=failed_step)
+        self.transition("rolled_back", step=step, reason=reason)
+        return step
+
+
+def monitor_ok(
+    pre_tau: Optional[float],
+    post_tau: Optional[float],
+    max_ratio: float,
+) -> bool:
+    """Post-promotion regression check on measured serve tau: the promoted
+    policy's measured mean tau may exceed the pre-promotion baseline by at
+    most `max_ratio`.  Missing measurements (no traffic in a window) pass —
+    absence of evidence must not trigger a rollback."""
+    if pre_tau is None or post_tau is None or pre_tau <= 0:
+        return True
+    return post_tau <= pre_tau * max_ratio

@@ -1,0 +1,145 @@
+"""Background re-fit: fine-tune the serving policy on captured experience.
+
+One jitted step: `vmap(agent.train_step.forward_backward)` over a packed
+experience batch (the service's own pad layout via
+`experience.replay_batches`), mean gradients across the batch, one
+optimizer update with the repo's Keras-parity Adam (`agent.replay`) and
+the post-update max-norm constraint.  Starting point is the CURRENT
+champion's parameters — a refit is a continuation, not a retrain — but
+the optimizer state is fresh: the offline run's moments describe a
+different data distribution and are not checkpointed into serving trees.
+
+The candidate is written to its own orbax tree (`<model_dir>/orbax_candidate`)
+with `source="refit"` lineage; it never touches the serving tree — only
+`loop.promote` moves weights there, after the sim gate passes.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from multihop_offload_tpu.agent.replay import (
+    apply_max_norm_constraint,
+    make_optimizer,
+)
+from multihop_offload_tpu.agent.train_step import forward_backward
+from multihop_offload_tpu.loop.experience import (
+    Outcome,
+    pad_for_outcomes,
+    replay_batches,
+)
+from multihop_offload_tpu.obs.registry import registry as obs_registry
+from multihop_offload_tpu.obs.spans import span
+from multihop_offload_tpu.train import checkpoints as ckpt_lib
+
+CANDIDATE_SUBDIR = "orbax_candidate"
+
+
+def candidate_dir(model_dir: str) -> str:
+    return os.path.join(model_dir, CANDIDATE_SUBDIR)
+
+
+def refit(
+    model,
+    variables,
+    outcomes: Sequence[Outcome],
+    cfg,
+    steps: Optional[int] = None,
+    slots: Optional[int] = None,
+    seed: int = 0,
+    pad=None,
+) -> tuple:
+    """Fine-tune `variables` on `outcomes`; returns (candidate_variables,
+    info dict).  Pure training — saving/lineage is `refit_and_save`."""
+    if not outcomes:
+        raise ValueError("refit needs at least one captured outcome")
+    steps = cfg.loop_refit_steps if steps is None else steps
+    slots = cfg.loop_refit_slots if slots is None else slots
+    pad = pad_for_outcomes(outcomes, round_to=cfg.round_to) if pad is None else pad
+
+    hop_cache: dict = {}
+    with span("loop/refit_pack", outcomes=len(outcomes)):
+        batches = list(replay_batches(
+            outcomes, pad, slots, dtype=cfg.jnp_dtype, hop_cache=hop_cache
+        ))
+    optimizer = make_optimizer(cfg)
+    params = variables["params"]
+    opt_state = optimizer.init(params)
+
+    prob = cfg.prob
+
+    @jax.jit
+    def step_fn(params, opt_state, binst, bjobs, keys):
+        def one(inst, jb, k):
+            out = forward_backward(
+                model, {"params": params}, inst, jb, k, prob=prob,
+            )
+            return out.grads["params"], out.loss_critic, out.loss_mse
+
+        grads, lc, lm = jax.vmap(one)(binst, bjobs, keys)
+        g = jax.tree_util.tree_map(lambda x: jnp.mean(x, axis=0), grads)
+        updates, opt_state = optimizer.update(g, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        params = apply_max_norm_constraint(params, 1.0)
+        return params, opt_state, jnp.mean(lc), jnp.mean(lm)
+
+    base_key = jax.random.PRNGKey(seed)
+    losses = []
+    with span("loop/refit", steps=steps, batches=len(batches)):
+        for s in range(steps):
+            binst, bjobs = batches[s % len(batches)]
+            keys = jax.random.split(jax.random.fold_in(base_key, s), slots)
+            params, opt_state, lc, lm = step_fn(
+                params, opt_state, binst, bjobs, keys
+            )
+            losses.append((float(lc), float(lm)))
+    obs_registry().counter(
+        "mho_loop_refit_steps_total", "experience fine-tuning steps run"
+    ).inc(steps)
+    info = {
+        "steps": steps,
+        "batches": len(batches),
+        "outcomes": len(outcomes),
+        "loss_critic_first": losses[0][0],
+        "loss_critic_last": losses[-1][0],
+        "loss_mse_last": losses[-1][1],
+    }
+    return {"params": params}, info
+
+
+def refit_and_save(
+    model,
+    variables,
+    outcomes: Sequence[Outcome],
+    cfg,
+    parent_step: Optional[int] = None,
+    seed: int = 0,
+    pad=None,
+) -> tuple:
+    """Run `refit` and persist the candidate with `source="refit"` lineage.
+    Returns (candidate_variables, candidate_step, info)."""
+    cand_vars, info = refit(
+        model, variables, outcomes, cfg, seed=seed, pad=pad
+    )
+    directory = candidate_dir(cfg.model_dir())
+    step = (ckpt_lib.latest_step(directory) or 0) + 1
+    host = jax.tree_util.tree_map(np.asarray, cand_vars)
+    ckpt_lib.save_checkpoint(
+        directory, step, host,
+        lineage=ckpt_lib.make_lineage(
+            "refit", parent_step=parent_step,
+            parent_dir=os.path.join(cfg.model_dir(), "orbax"), cfg=cfg,
+            extra={"outcomes": len(outcomes),
+                   "refit_steps": info["steps"]},
+        ),
+    )
+    obs_registry().counter(
+        "mho_loop_refits_total", "candidate checkpoints produced"
+    ).inc()
+    return cand_vars, step, info

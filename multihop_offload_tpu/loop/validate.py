@@ -1,0 +1,179 @@
+"""Sim-gated A/B validation: champion vs candidate on held-out workload.
+
+The held-out slice of the captured experience (`experience.split_holdout`)
+is replayed through the packet-level simulator (`sim.runner.FleetSim`) —
+NOT through the analytic evaluator the candidate was just fit on — once
+under the champion's weights and once under the candidate's.  Same
+instances, same arrival randomness (shared PRNG keys), same horizon; the
+only difference is the policy deciding offloads each round, so the score
+deltas are attributable to the weights alone.
+
+Two `FleetSim`s are built per comparison because `sim.policies.make_policy`
+closes over its variables (the compiled program treats them as constants —
+that is what makes the per-round policy free of host round-trips).  The
+validator is a batch job off the serving path, so the extra compile is
+paid where it is cheap; it never calls `mark_steady`.
+
+`apply_gates` is the pure decision rule — configurable absolute
+delivered-ratio drop and relative tau (mean packet delay) ratio — kept
+free of sim state so tests can drive it on synthetic score pairs.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from multihop_offload_tpu.graphs.instance import (
+    build_instance,
+    build_jobset,
+    stack_instances,
+)
+from multihop_offload_tpu.loop.experience import Outcome, pad_for_outcomes
+from multihop_offload_tpu.obs.spans import span
+from multihop_offload_tpu.sim.policies import make_policy
+from multihop_offload_tpu.sim.runner import FleetSim
+from multihop_offload_tpu.sim.state import build_sim_params, spec_for
+
+
+def build_validation_fleet(
+    outcomes: Sequence[Outcome],
+    pad=None,
+    margin: float = 5.0,
+    round_to: int = 8,
+    dtype=np.float32,
+):
+    """Stack the held-out requests into one sim fleet.
+
+    Returns (insts, jobss, paramss, init_rates, dts, spec_args) — all lanes
+    share one pad shape so champion and candidate each run ONE compiled
+    program over the whole slice."""
+    pad = pad_for_outcomes(outcomes, round_to=round_to) if pad is None else pad
+    insts, jobss, params_list = [], [], []
+    for o in outcomes:
+        r = o.request
+        inst = build_instance(
+            r.topo, r.roles, r.proc_bws, r.link_rates, r.t_max, pad,
+            dtype=dtype, device=False,
+        )
+        jobs = build_jobset(
+            r.job_src, r.job_rate, pad_jobs=pad.j, ul=r.ul, dl=r.dl,
+            dtype=dtype, device=False,
+        )
+        insts.append(inst)
+        jobss.append(jobs)
+        params_list.append(build_sim_params(inst, jobs, margin=margin))
+    init_rates = np.stack([np.asarray(j.rate) for j in jobss])
+    dts = np.asarray([float(p.dt) for p in params_list])
+    return (
+        stack_instances(insts),
+        stack_instances(jobss),
+        stack_instances(params_list),
+        init_rates,
+        dts,
+        (insts[0], jobss[0]),
+    )
+
+
+def score_run(state, dts: np.ndarray) -> dict:
+    """Summarize one fleet run: delivered ratio + delivered-weighted mean
+    packet delay in model time (per-lane dt restores the time unit)."""
+    st = jax.tree_util.tree_map(np.asarray, state)
+    generated = int(st.generated.sum())
+    delivered = int(st.delivered.sum())
+    dropped = int(st.dropped.sum())
+    # delay_sum is in slots; convert per lane, then pool over the fleet
+    lane_delay = (st.delay_sum.sum(axis=1) * dts)
+    lane_delivered = st.delivered.sum(axis=1)
+    total_delivered = lane_delivered.sum()
+    mean_delay = (
+        float(lane_delay.sum() / total_delivered) if total_delivered else None
+    )
+    return {
+        "generated": generated,
+        "delivered": delivered,
+        "dropped": dropped,
+        "delivered_ratio": delivered / max(generated, 1),
+        "mean_packet_delay": mean_delay,
+    }
+
+
+def ab_compare(
+    model,
+    champion_variables,
+    candidate_variables,
+    outcomes: Sequence[Outcome],
+    rounds: int = 2,
+    slots_per_round: int = 200,
+    cap: int = 64,
+    margin: float = 5.0,
+    seed: int = 0,
+    round_to: int = 8,
+    precision=None,
+    dtype=np.float32,
+) -> dict:
+    """Replay the held-out workload under both policies; returns
+    {"champion": score, "candidate": score, ...}."""
+    if not outcomes:
+        raise ValueError("validation needs at least one held-out outcome")
+    insts, jobss, paramss, init_rates, dts, (inst0, jobs0) = (
+        build_validation_fleet(
+            outcomes, margin=margin, round_to=round_to, dtype=dtype
+        )
+    )
+    spec = spec_for(inst0, jobs0, cap=cap)
+    fleet = len(outcomes)
+    keys = jax.random.split(jax.random.PRNGKey(seed), fleet)
+    scores = {}
+    for name, variables in (
+        ("champion", champion_variables), ("candidate", candidate_variables)
+    ):
+        policy = make_policy(
+            "gnn", model=model, variables=variables, precision=precision
+        )
+        sim = FleetSim(
+            spec, policy, rounds=rounds, slots_per_round=slots_per_round
+        )
+        with span("loop/validate", arm=name, fleet=fleet):
+            run = sim.run(insts, jobss, paramss, keys,
+                          init_rates=init_rates)
+        scores[name] = score_run(run.state, dts)
+    scores["fleet"] = fleet
+    scores["slots"] = rounds * slots_per_round
+    return scores
+
+
+def apply_gates(
+    champion: dict,
+    candidate: dict,
+    max_delivered_drop: float,
+    max_tau_ratio: float,
+) -> tuple:
+    """(ok, reasons): the promotion decision rule on two score dicts.
+
+    - delivered ratio may drop at most `max_delivered_drop` (absolute);
+    - mean packet delay (tau proxy) may grow at most `max_tau_ratio`
+      (relative).  A candidate with no delivered packets fails outright;
+      a champion with none passes the tau gate vacuously (nothing to
+      regress against).
+    """
+    reasons: List[str] = []
+    dr_c = champion.get("delivered_ratio", 0.0)
+    dr_n = candidate.get("delivered_ratio", 0.0)
+    if dr_n < dr_c - max_delivered_drop:
+        reasons.append(
+            f"delivered_ratio {dr_n:.4f} < champion {dr_c:.4f} "
+            f"- {max_delivered_drop}"
+        )
+    tau_c: Optional[float] = champion.get("mean_packet_delay")
+    tau_n: Optional[float] = candidate.get("mean_packet_delay")
+    if tau_n is None and candidate.get("generated", 0) > 0:
+        reasons.append("candidate delivered no packets")
+    elif tau_c is not None and tau_n is not None and tau_n > tau_c * max_tau_ratio:
+        reasons.append(
+            f"mean_packet_delay {tau_n:.4f} > champion {tau_c:.4f} "
+            f"* {max_tau_ratio}"
+        )
+    return (not reasons), reasons

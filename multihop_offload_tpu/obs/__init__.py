@@ -16,6 +16,7 @@ from multihop_offload_tpu.obs.events import (  # noqa: F401
     get_run_log,
     read_events,
     run_manifest,
+    segment_paths,
     set_run_log,
 )
 from multihop_offload_tpu.obs.registry import (  # noqa: F401
@@ -41,7 +42,8 @@ def start_run(cfg, role: str):
     from multihop_offload_tpu.obs import jaxhooks
 
     jaxhooks.install()
-    log = RunLog(path, manifest=run_manifest(cfg, role=role))
+    log = RunLog(path, manifest=run_manifest(cfg, role=role),
+                 max_bytes=getattr(cfg, "obs_log_max_bytes", 0) or None)
     log.prom_path = getattr(cfg, "obs_prom", "") or None
     set_run_log(log)
     return log

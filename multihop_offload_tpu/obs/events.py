@@ -10,23 +10,32 @@ consistent across Trainer / Evaluator / OffloadService / bench so
 Writes are lock-guarded (the serve tick loop and a main thread may share
 one log) and line-buffered to bound instrumentation overhead; `close()`
 and `summary()` flush.
+
+Long-running logs (a service the continual-learning flywheel tails forever)
+rotate by size: pass `max_bytes` and a segment that would grow past it is
+renamed to ``<path>.NNNN`` (ascending age) and a fresh segment opened at
+`path` with a small ``segment`` header row.  `read_events` spans the whole
+segment chain transparently and stays tolerant of a truncated final line
+in ANY segment (a crash can interrupt a rotation too).
 """
 
 from __future__ import annotations
 
+import glob as _glob
 import hashlib
 import json
 import os
+import re
 import threading
 import time
-from typing import Iterator, Optional
+from typing import Iterator, List, Optional
 
 SCHEMA_VERSION = 1
 
 # event types with a typed helper; emit() accepts any type, the report
 # renders unknown ones generically
-EVENT_TYPES = ("manifest", "step", "tick", "epoch", "checkpoint", "phase",
-               "span", "summary")
+EVENT_TYPES = ("manifest", "segment", "step", "tick", "epoch", "checkpoint",
+               "phase", "span", "summary", "outcome")
 
 
 def _git_sha() -> Optional[str]:
@@ -100,22 +109,51 @@ def run_manifest(cfg=None, role: str = "") -> dict:
 
 
 class RunLog:
-    """Append-only JSONL sink with the manifest as its first line."""
+    """Append-only JSONL sink with the manifest as its first line.
 
-    def __init__(self, path: str, manifest: Optional[dict] = None):
+    With `max_bytes` set, a segment about to exceed the cap is rotated:
+    the active file moves to ``<path>.NNNN`` and a fresh ``<path>`` opens
+    with a ``segment`` header so readers (and humans) can tell the chain
+    apart from independent runs.  Rotation happens under the write lock,
+    so concurrent emitters never interleave across a boundary.
+    """
+
+    def __init__(self, path: str, manifest: Optional[dict] = None,
+                 max_bytes: Optional[int] = None):
         self.path = path
+        self.max_bytes = int(max_bytes) if max_bytes else 0
         self._lock = threading.Lock()
+        self._seq = 0          # next rotated-segment suffix
+        self._bytes = 0        # bytes written to the active segment
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
         self._f = open(path, "w", buffering=1)  # line-buffered
         self._closed = False
         self._write(manifest if manifest is not None else run_manifest())
 
+    def _rotate_locked(self) -> None:
+        """Move the active segment aside and open a fresh one. Caller
+        holds the lock."""
+        self._f.flush()
+        self._f.close()
+        os.replace(self.path, f"{self.path}.{self._seq:04d}")
+        self._seq += 1
+        self._f = open(self.path, "w", buffering=1)
+        header = json.dumps({"event": "segment", "ts": time.time(),
+                             "seq": self._seq}) + "\n"
+        self._f.write(header)
+        self._bytes = len(header)
+
     def _write(self, rec: dict) -> None:
-        line = json.dumps(rec, default=str)
+        line = json.dumps(rec, default=str) + "\n"
         with self._lock:
-            if not self._closed:
-                self._f.write(line + "\n")
+            if self._closed:
+                return
+            if (self.max_bytes and self._bytes
+                    and self._bytes + len(line) > self.max_bytes):
+                self._rotate_locked()
+            self._f.write(line)
+            self._bytes += len(line)
 
     def emit(self, event: str, **fields) -> None:
         self._write({"event": event, "ts": time.time(), **fields})
@@ -181,15 +219,32 @@ def emit(event: str, **fields) -> None:
         log.emit(event, **fields)
 
 
+def segment_paths(path: str) -> List[str]:
+    """All segments of a (possibly rotated) run log, oldest first: the
+    rotated ``<path>.NNNN`` files in suffix order, then the active file."""
+    suffixed = []
+    pat = re.compile(re.escape(os.path.basename(path)) + r"\.(\d{4,})$")
+    for p in _glob.glob(path + ".*"):
+        m = pat.match(os.path.basename(p))
+        if m:
+            suffixed.append((int(m.group(1)), p))
+    out = [p for _, p in sorted(suffixed)]
+    if os.path.exists(path):
+        out.append(path)
+    return out
+
+
 def read_events(path: str) -> Iterator[dict]:
-    """Iterate a run.jsonl's rows; tolerates a truncated final line (a
-    crashed run's log must still render)."""
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                yield json.loads(line)
-            except ValueError:
-                continue
+    """Iterate a run log's rows across all rotated segments (oldest
+    first); tolerates a truncated final line in any segment (a crashed
+    run's log must still render — and a crash can interrupt a rotation)."""
+    for seg in segment_paths(path) or [path]:
+        with open(seg) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    yield json.loads(line)
+                except ValueError:
+                    continue

@@ -183,6 +183,35 @@ def render_report(path: str) -> str:
                          f"{last_tick['queue_depth']}")
         lines.append("")
 
+    loop_counters = {
+        name: _counter_by_label(metrics, name) for name in metrics
+        if name.startswith("mho_loop_")
+    }
+    last_reload = run["last"].get("hot_reload")
+    if loop_counters or last_reload:
+        lines.append("continual learning")
+        for name in sorted(loop_counters):
+            for lab, v in sorted(loop_counters[name].items()):
+                tag = f"{name}{'' if lab == '(total)' else lab}"
+                val = int(v) if float(v) == int(v) else round(v, 4)
+                lines.append(f"  {tag:<42} {val}")
+        if last_reload:
+            lin = ", ".join(
+                f"{k}={last_reload[k]}"
+                for k in ("step", "source", "parent_step", "git_sha")
+                if last_reload.get(k) not in (None, "")
+            )
+            lines.append(f"  {'serving weights (last hot_reload)':<42} {lin}")
+        for et in ("promotion", "rollback", "rejection"):
+            ev = run["last"].get(et)
+            if ev:
+                detail = ", ".join(
+                    f"{k}={ev[k]}" for k in ("step", "reason", "failed_step")
+                    if ev.get(k) not in (None, "")
+                )
+                lines.append(f"  {f'last {et}':<42} {detail or '(recorded)'}")
+        lines.append("")
+
     mem = _counter_by_label(metrics, "mho_device_peak_bytes_in_use")
     if mem:
         lines.append("device memory (peak bytes)")

@@ -29,6 +29,17 @@ from multihop_offload_tpu.serve.bucketing import ShapeBuckets
 from multihop_offload_tpu.train import checkpoints as ckpt_lib
 
 
+def param_signature(tree):
+    """Structural signature of a param tree: (path, shape, dtype) per leaf.
+
+    The hot-reload / promotion gate: two trees with equal signatures can be
+    swapped without retracing or reshaping; anything else must be rejected
+    BEFORE the swap, not discovered as a shape/dtype error mid-tick."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [(jax.tree_util.keystr(p), tuple(np.shape(x)),
+             str(np.asarray(x).dtype)) for p, x in flat]
+
+
 class BucketExecutor:
     """Compiled decision programs over a bucket ladder, plus weight state."""
 
@@ -51,6 +62,7 @@ class BucketExecutor:
         self.buckets = buckets
         self.dispatch_count = 0
         self.loaded_step: Optional[int] = None
+        self.loaded_lineage: Optional[dict] = None
         # mixed-precision policy (str | PrecisionPolicy | None): resolved
         # once and baked into the per-bucket closures — no retrace on enable
         self.precision = resolve_precision(precision)
@@ -104,14 +116,10 @@ class BucketExecutor:
         restored = ckpt_lib.restore_checkpoint_raw(directory, step)
         cur = self.variables["params"]
 
-        def _shapes(tree):
-            flat, _ = jax.tree_util.tree_flatten_with_path(tree)
-            return [(jax.tree_util.keystr(p), np.shape(x)) for p, x in flat]
-
-        if _shapes(restored["params"]) != _shapes(cur):
+        if param_signature(restored["params"]) != param_signature(cur):
             raise ValueError(
                 f"checkpoint {directory} step {step} params do not match the "
-                "serving model architecture"
+                "serving model architecture (tree/shape/dtype signature)"
             )
         # rebuild in the live tree's container types, cast to live dtypes
         leaves = jax.tree_util.tree_leaves(restored["params"])
@@ -123,4 +131,5 @@ class BucketExecutor:
         )
         self.variables = {"params": params}
         self.loaded_step = step
+        self.loaded_lineage = ckpt_lib.load_lineage(directory, step)
         return step

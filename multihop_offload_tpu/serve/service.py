@@ -61,6 +61,7 @@ class OffloadService:
         dtype=None,
         precision=None,
         clock: Callable[[], float] = time.monotonic,
+        capture_sample: float = 0.0,
     ):
         from multihop_offload_tpu.precision import resolve_precision
 
@@ -81,6 +82,11 @@ class OffloadService:
         self.deadline_s = deadline_s
         self.dtype = self.precision.storage_dtype
         self.clock = clock
+        # experience capture: fraction of answered requests logged as
+        # "outcome" events through the active run log (the continual-
+        # learning flywheel's input; 0 = off).  Deterministic per request
+        # id — see loop.experience.sampled.
+        self.capture_sample = float(capture_sample)
         self.stats = ServingStats()
         self._queues: List[Deque[Tuple[OffloadRequest, float]]] = [
             deque() for _ in buckets.pads
@@ -145,9 +151,11 @@ class OffloadService:
                     degraded=degraded,
                 )
                 t_done = self.clock() if now is None else now
-                responses.extend(demux_responses(
+                batch_responses = demux_responses(
                     taken, out, "baseline" if degraded else "gnn", b, t_done
-                ))
+                )
+                responses.extend(batch_responses)
+                self._capture_outcomes(reqs, batch_responses)
                 waste = padding_waste(reqs, pad, self.slots)
                 self.stats.record_dispatch(
                     b, len(reqs), self.slots, waste, degraded
@@ -166,6 +174,27 @@ class OffloadService:
                 degraded_batches=degraded_batches, queue_depth=depth,
             )
         return responses
+
+    def _capture_outcomes(self, reqs, batch_responses) -> None:
+        """Emit sampled per-request "outcome" events (experience capture for
+        the loop/ flywheel).  No-op without an active run log or with the
+        sampling knob at 0 — the hot path pays one float compare."""
+        if self.capture_sample <= 0.0 or obs_events.get_run_log() is None:
+            return
+        from multihop_offload_tpu.loop import experience
+
+        captured = 0
+        for req, resp in zip(reqs, batch_responses):
+            if experience.sampled(req.request_id, self.capture_sample):
+                obs_events.emit(
+                    "outcome", **experience.outcome_record(req, resp)
+                )
+                captured += 1
+        if captured:
+            obs_registry().counter(
+                "mho_serve_outcomes_captured_total",
+                "answered requests logged as experience",
+            ).inc(captured)
 
     def drain(self, max_ticks: int = 1000) -> List[OffloadResponse]:
         """Tick until every admitted request is answered (bounded)."""
@@ -187,7 +216,12 @@ class OffloadService:
                 "mho_serve_hot_reloads_total",
                 "policy swaps without restart",
             ).inc()
-            obs_events.emit("hot_reload", step=step)
+            lin = self.executor.loaded_lineage or {}
+            obs_events.emit(
+                "hot_reload", step=step,
+                source=lin.get("source"), git_sha=lin.get("git_sha"),
+                parent_step=lin.get("parent_step"),
+            )
         return step
 
 

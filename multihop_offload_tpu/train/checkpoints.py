@@ -9,7 +9,9 @@ interop lives in `models.tf_import.save_reference_checkpoint`.
 
 from __future__ import annotations
 
+import json
 import os
+import time
 from typing import Any, Optional
 
 import jax
@@ -24,11 +26,66 @@ def _manager(directory: str) -> ocp.CheckpointManager:
     )
 
 
-def save_checkpoint(directory: str, step: int, state: Any) -> None:
-    """state: any pytree (params / opt_state / counters)."""
+def save_checkpoint(directory: str, step: int, state: Any,
+                    lineage: Optional[dict] = None) -> None:
+    """state: any pytree (params / opt_state / counters).
+
+    `lineage` (see `make_lineage`) is written as a JSON sidecar under
+    `directory/lineage/<step>.json` — outside the orbax step directory so
+    orbax's strict layout checks never see it, and it survives template
+    changes.  The promotion controller and `mho-obs` use it to answer
+    "where did the serving weights come from".
+    """
     with _manager(directory) as mgr:
         mgr.save(step, args=ocp.args.StandardSave(state))
         mgr.wait_until_finished()
+    if lineage is not None:
+        ldir = os.path.join(os.path.abspath(directory), "lineage")
+        os.makedirs(ldir, exist_ok=True)
+        with open(os.path.join(ldir, f"{int(step)}.json"), "w") as f:
+            json.dump({"step": int(step), **lineage}, f, sort_keys=True,
+                      default=str)
+
+
+def make_lineage(source: str, parent_step: Optional[int] = None,
+                 parent_dir: Optional[str] = None, cfg=None,
+                 extra: Optional[dict] = None) -> dict:
+    """Provenance record for a checkpoint: who trained it, from what.
+
+    source: "offline" (file-visit Trainer), "refit" (loop/ background
+    trainer), or "rollback" (promotion controller re-pinning a champion).
+    """
+    from multihop_offload_tpu.obs import events as obs_events
+
+    lin = {
+        "source": source,
+        "ts": time.time(),
+        "git_sha": obs_events._git_sha(),
+        "config_hash": obs_events.config_hash(cfg) if cfg is not None else None,
+        "parent_step": parent_step,
+        "parent_dir": os.path.abspath(parent_dir) if parent_dir else None,
+    }
+    if extra:
+        lin.update(extra)
+    return lin
+
+
+def load_lineage(directory: str, step: Optional[int] = None) -> Optional[dict]:
+    """The lineage sidecar for `step` (default: latest saved step), or
+    None when the checkpoint predates lineage tracking."""
+    if step is None:
+        step = latest_step(directory)
+    if step is None:
+        return None
+    path = os.path.join(os.path.abspath(directory), "lineage",
+                        f"{int(step)}.json")
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (ValueError, OSError):
+        return None
 
 
 def latest_step(directory: str) -> Optional[int]:

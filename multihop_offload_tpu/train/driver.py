@@ -296,7 +296,10 @@ class _Harness:
             "opt_state": self.opt_state,
             "step": step,
         }
-        ckpt_lib.save_checkpoint(os.path.join(self.model_dir, "orbax"), step, state)
+        ckpt_lib.save_checkpoint(
+            os.path.join(self.model_dir, "orbax"), step, state,
+            lineage=ckpt_lib.make_lineage("offline", cfg=self.cfg),
+        )
 
     def save_best(self, step: int, tau: float):
         """Best-so-far checkpoint (rolling GNN-test tau): the training
@@ -310,7 +313,13 @@ class _Harness:
             "step": step,
         }
         directory = os.path.join(self.model_dir, "orbax_best")
-        ckpt_lib.save_checkpoint(directory, step, state)
+        ckpt_lib.save_checkpoint(
+            directory, step, state,
+            lineage=ckpt_lib.make_lineage(
+                "offline", cfg=self.cfg,
+                extra={"rolling_gnn_test_tau": tau},
+            ),
+        )
         if self.is_host0:
             import json
 
@@ -652,7 +661,8 @@ class Trainer(_Harness):
                         self.save_best(gidx, roll)
                         if runlog is not None:
                             runlog.checkpoint(step=gidx, kind="best",
-                                              rolling_tau=roll)
+                                              rolling_tau=roll,
+                                              source="offline")
 
                 # replay: the only weight update (`AdHoc_train.py:187`)
                 loss = float("nan")
@@ -671,7 +681,8 @@ class Trainer(_Harness):
                 if np.isfinite(loss):
                     self.save(gidx)
                     if runlog is not None:
-                        runlog.checkpoint(step=gidx, kind="latest")
+                        runlog.checkpoint(step=gidx, kind="latest",
+                                          source="offline")
                     explore = float(np.clip(explore * cfg.explore_decay, 0.0, 1.0))
                     if verbose:
                         print(f"{gidx} Loss: {np.nanmean(losses):.2f}, "

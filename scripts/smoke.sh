@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# One-command pre-merge smoke: lint + the two fast end-to-end CLI proofs.
+#
+#   bash scripts/smoke.sh
+#
+# Chains (each must pass; total budget well under 90s on a CPU host):
+#   1. bash scripts/lint.sh          — ruff (or the stdlib AST fallback)
+#      plus the repo's MP001 mixed-precision rule;
+#   2. mho-sim --smoke               — tiny simulator fleet: exact packet
+#      conservation + a link-failure round;
+#   3. mho-loop --smoke              — the continual-learning flywheel end
+#      to end: capture -> refit -> sim-gated A/B -> promote through
+#      hot-reload (zero unexpected retraces) -> injected regression ->
+#      automatic rollback; writes benchmarks/loop_smoke.json.
+#
+# This is the tier-1-ADJACENT gate (ROADMAP "quick checks") — it does not
+# replace the pytest tier-1 run.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+echo "== [1/3] lint =="
+bash scripts/lint.sh
+
+echo "== [2/3] mho-sim --smoke =="
+python -m multihop_offload_tpu.cli.sim --smoke
+
+echo "== [3/3] mho-loop --smoke =="
+python -m multihop_offload_tpu.cli.loop --smoke
+
+echo "smoke: all green"

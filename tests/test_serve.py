@@ -233,6 +233,46 @@ def test_hot_reload_swaps_weights_without_retrace():
             service.hot_reload(d)
 
 
+def test_repeated_hot_reloads_stay_steady(tmp_path):
+    """The flywheel's serving invariant: N successive orbax hot-reloads are
+    pure weight swaps — `jax_unexpected_retraces_total` stays at 0 once the
+    service declares steady state, and the loaded step is monotone."""
+    from multihop_offload_tpu.obs import jaxhooks
+    from multihop_offload_tpu.obs.registry import registry as obs_registry
+    from multihop_offload_tpu.train import checkpoints as ckpt_lib
+
+    obs_registry().reset()
+    service, pool = _make_service(slots=2)
+    req = next(iter(request_stream(pool, 1, seed=51)))
+    d = str(tmp_path / "model")
+    base = jax.tree_util.tree_map(
+        np.asarray, service.executor.variables["params"]
+    )
+    # first save+reload also warms the orbax restore path pre-steady
+    ckpt_lib.save_checkpoint(os.path.join(d, "orbax"), 1, {"params": base})
+    assert service.hot_reload(d) == 1
+    service.submit(req)
+    service.drain()  # compiles the bucket's decision program
+    jaxhooks.mark_steady()
+    try:
+        steps = [service.executor.loaded_step]
+        for k in range(2, 6):
+            bumped = jax.tree_util.tree_map(lambda x: x + 0.01 * k, base)
+            ckpt_lib.save_checkpoint(
+                os.path.join(d, "orbax"), k, {"params": bumped}
+            )
+            assert service.hot_reload(d) == k
+            steps.append(service.executor.loaded_step)
+            service.submit(req)
+            service.drain()  # serve THROUGH the swapped weights, post-steady
+        assert steps == sorted(steps) == [1, 2, 3, 4, 5]
+        assert jaxhooks.unexpected_retraces() == 0, (
+            "hot reload retraced after steady state"
+        )
+    finally:
+        jaxhooks.clear_steady()
+
+
 @pytest.mark.slow
 def test_loadgen_soak(tmp_path):
     """The committed-record path end to end at reduced scale: both legs,
