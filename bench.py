@@ -112,6 +112,16 @@ def _bench_precision():
     return resolve_precision(os.environ.get("BENCH_PRECISION", "fp32"))
 
 
+def _bench_layout():
+    """The bench's instance layout, from BENCH_LAYOUT (dense | sparse | auto;
+    default dense — the committed baseline records stay comparable).  Same
+    resolve-in-both-places contract as `_bench_precision`;
+    `scripts/layout_ab.py` flips this knob per leg."""
+    from multihop_offload_tpu.layouts import resolve_layout
+
+    return resolve_layout(os.environ.get("BENCH_LAYOUT", "dense"))
+
+
 def _hand_flop_count(pad_n, pad_l, pad_e, batch, cheb_k=1, layers=5, hidden=32,
                      fp_iters=10):
     """Analytic FLOPs/step sanity check for the cost-analysis number.
@@ -177,6 +187,7 @@ def build_bench_batch():
     per_network = int(os.environ.get("BENCH_INSTANCES", 4))
     arrival_scale = 0.15
     pol = _bench_precision()
+    lay = _bench_layout()
     storage = pol.storage_dtype  # bf16 halves the batch's HBM working set
     rng = np.random.default_rng(0)
     recs = _load_cases(num_networks, rng)
@@ -195,7 +206,8 @@ def build_bench_batch():
     for rec in recs:
         rates = sample_link_rates(rec.topo, rec.link_rates, rng=rng)
         inst = build_instance(
-            rec.topo, rec.roles, rec.proc_bws, rates, 1000.0, pad, storage
+            rec.topo, rec.roles, rec.proc_bws, rates, 1000.0, pad, storage,
+            layout=lay,
         )
         for _ in range(per_network):
             mobile = rng.permutation(rec.mobile_nodes)
@@ -203,25 +215,36 @@ def build_bench_batch():
             jobsets.append(build_jobset(
                 mobile[:nj], arrival_scale * rng.uniform(0.1, 0.5, nj),
                 pad_jobs=pad.j, dtype=storage,
+                index_dtype=lay.index_dtype,
             ))
             insts.append(inst)
     binst = stack_instances(insts)
     bjobs = stack_instances(jobsets)
     batch = len(insts)
 
+    propagate = None
+    if lay.sparse:
+        from multihop_offload_tpu.layouts import make_sparse_propagate
+
+        propagate = make_sparse_propagate(
+            pol.accum_dtype if pol.mixed else None
+        )
     model = ChebNet(
         param_dtype=pol.param_dtype,
         compute_dtype=pol.compute_dtype if pol.mixed else None,
         accum_dtype=pol.accum_dtype if pol.mixed else None,
+        propagate=propagate,
     )
     ckpt = "/root/reference/model/model_ChebConv_BAT800_a5_c5_ACO_agent"
     if os.path.isdir(ckpt):
         variables = load_reference_checkpoint(ckpt, dtype=pol.param_dtype)
     else:
+        from multihop_offload_tpu.layouts import zeros_support
+
         variables = model.init(
             jax.random.PRNGKey(0),
             jnp.zeros((pad.e, 4), storage),
-            jnp.zeros((pad.e, pad.e), storage),
+            zeros_support(pad, storage, lay),
         )
     return model, variables, binst, bjobs, pad, batch
 
@@ -279,13 +302,14 @@ def measure():
     # point islands itself to fp32 internally — no wrap needed on fp_fn)
     precision = _bench_precision()
     apsp_fn = precision.wrap_apsp(apsp_fn)
+    layout = _bench_layout()
 
     @jax.jit
     def step(variables, insts, jobs, keys):
         outs = jax.vmap(
             lambda i, jb, k: forward_backward(model, variables, i, jb, k,
                                               explore=0.0, apsp_fn=apsp_fn,
-                                              fp_fn=fp_fn)
+                                              fp_fn=fp_fn, layout=layout)
         )(insts, jobs, keys)
         return outs.grads, outs.loss_critic, outs.delays.job_total
 
@@ -379,8 +403,10 @@ def measure():
         "apsp_path": apsp_path,
         "fp_path": fp_path,
         "precision": precision.name,
+        "layout": layout.name,
         "roofline": {
             "compute_dtype": str(jnp.dtype(precision.compute_dtype)),
+            "layout": layout.name,
             "flops_per_step": flops_per_step,
             "flops_per_step_corrected": flops_corrected,
             "flops_per_step_hand": _hand_flop_count(pad.n, pad.l, pad.e, batch),

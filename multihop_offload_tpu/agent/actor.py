@@ -34,7 +34,7 @@ class ActorOutput:
     lam: jnp.ndarray           # (E,) raw GNN output
 
 
-def default_support(model, inst: Instance) -> jnp.ndarray:
+def default_support(model, inst: Instance, layout=None) -> jnp.ndarray:
     """Support matrix when the caller doesn't supply one.
 
     k=1: the raw extended adjacency — the reference's shipped behavior (it
@@ -46,7 +46,33 @@ def default_support(model, inst: Instance) -> jnp.ndarray:
     the predicted rates never influenced a single offloading decision in
     300 training visits — training ran, gradients flowed, policy never
     moved.  The support must match the model order by default.
+
+    Under `layout=sparse` (requires a sparse-built Instance) the support is
+    the edge-list `layouts.SparseSupport` — same Laplacian math over the
+    extended adjacency's COO form, consumed by the model's segment-sum
+    `propagate` (the model must have been built with the same layout).
     """
+    from multihop_offload_tpu.layouts import resolve_layout
+
+    if resolve_layout(layout).sparse and inst.sparse is not None:
+        from multihop_offload_tpu.layouts import (
+            SparseSupport,
+            sparse_chebyshev_support,
+        )
+
+        if model.k >= 2:
+            return sparse_chebyshev_support(
+                inst.sparse.ext, mask=inst.ext_mask
+            )
+        # raw extended adjacency in edge-list form (zero diagonal, like the
+        # dense twin — line-graph adjacency carries no self loops); with
+        # k=1 the support is unused and pruned either way
+        return SparseSupport(
+            edges=inst.sparse.ext,
+            diag=jnp.zeros(
+                (inst.ext_mask.shape[0],), inst.sparse.ext.vals.dtype
+            ),
+        )
     if model.k >= 2:
         from multihop_offload_tpu.models.chebconv import chebyshev_support
 
@@ -73,19 +99,22 @@ def build_ext_features(inst: Instance, jobs: JobSet) -> jnp.ndarray:
 
 
 def lambdas_to_delay_matrix(
-    inst: Instance, lam: jnp.ndarray, fp_fn=None
+    inst: Instance, lam: jnp.ndarray, fp_fn=None, layout=None
 ) -> ActorOutput:
     """Differentiable head: lambda (E,) -> delay matrix
     (`gnn_offloading_agent.py:229-276`).  `fp_fn` overrides the fixed-point
     core (the `fp_impl` knob; Pallas kernel carries a custom_vjp so this
-    stays differentiable either way)."""
+    stays differentiable either way); `layout` picks the gathered
+    conflict-neighborhood reduction instead of the dense (L, L) matmul."""
     num_links = inst.num_pad_links
     n = inst.num_pad_nodes
     lam = lam * inst.ext_mask  # padded slots predict nothing
     link_lambda = lam[:num_links]
     node_lambda = jnp.where(inst.comp_mask, lam[num_links:], 0.0)
 
-    link_mu = interference_fixed_point(inst, link_lambda, fp_fn=fp_fn)
+    link_mu = interference_fixed_point(
+        inst, link_lambda, fp_fn=fp_fn, layout=layout
+    )
     # link unit delay 1/(mu-lambda); congested (lambda-mu > 0, strict — the
     # empirical evaluator uses >=, a reference asymmetry we keep) replaced by
     # T*lambda/(101*mu)  (`:245-253`)
@@ -152,10 +181,11 @@ def actor_delay_matrix(
     deterministic: bool = True,
     dropout_rng: jax.Array | None = None,
     fp_fn=None,
+    layout=None,
 ) -> ActorOutput:
     feats = build_ext_features(inst, jobs)
     rngs = {"dropout": dropout_rng} if dropout_rng is not None else None
     lam = model.apply(
         variables, feats, support, deterministic=deterministic, rngs=rngs
     )[:, 0]
-    return lambdas_to_delay_matrix(inst, lam, fp_fn=fp_fn)
+    return lambdas_to_delay_matrix(inst, lam, fp_fn=fp_fn, layout=layout)

@@ -33,19 +33,29 @@ def forward_env(
     apsp_fn=None,
     fp_fn=None,
     compat_diagonal_bug: bool = False,
+    layout=None,
 ) -> tuple[PolicyOutcome, ActorOutput]:
     """`compat_diagonal_bug=True` feeds the decision path the reference's
     cycled node-delay diagonal (`compat_cycled_diagonal`) instead of the
     correct scatter — the A/B switch for matching its published numbers."""
     if support is None:
-        support = default_support(model, inst)
-    actor = actor_delay_matrix(model, variables, inst, jobs, support, fp_fn=fp_fn)
+        support = default_support(model, inst, layout=layout)
+    from multihop_offload_tpu.layouts import resolve_layout
+
+    actor = actor_delay_matrix(
+        model, variables, inst, jobs, support, fp_fn=fp_fn, layout=layout
+    )
     if compat_diagonal_bug:
         unit_diag = compat_cycled_diagonal(inst, actor.node_delay)
+    elif resolve_layout(layout).sparse:
+        # bit-identical to the dense diagonal read, but keeps the (N, N)
+        # delay-matrix scatter out of the program when nothing else reads it
+        unit_diag = jnp.where(inst.comp_mask, actor.node_delay, jnp.inf)
     else:
         unit_diag = jnp.diagonal(actor.delay_matrix)
     outcome = evaluate_spmatrix_policy(
         inst, jobs, actor.link_delay, unit_diag, key,
         explore=explore, prob=prob, apsp_fn=apsp_fn, fp_fn=fp_fn,
+        layout=layout,
     )
     return outcome, actor

@@ -39,6 +39,7 @@ from multihop_offload_tpu.agent.actor import (
 )
 from multihop_offload_tpu.env.apsp import (
     apsp_minplus,
+    apsp_minplus_blocked,
     next_hop_table,
     weight_matrix_from_link_delays,
 )
@@ -50,6 +51,11 @@ from multihop_offload_tpu.env.queueing import (
 )
 from multihop_offload_tpu.env.routing import RouteSet, trace_routes
 from multihop_offload_tpu.graphs.instance import Instance, JobSet
+from multihop_offload_tpu.layouts import (
+    next_hop_from_edges,
+    resolve_layout,
+    weight_matrix_from_edges,
+)
 from multihop_offload_tpu.precision import island_dtype
 
 
@@ -65,7 +71,8 @@ class TrainStepOutput:
 
 
 def _critic_loss(
-    inst: Instance, jobs: JobSet, routes_inc: jnp.ndarray, fp_fn=None
+    inst: Instance, jobs: JobSet, routes_inc: jnp.ndarray, fp_fn=None,
+    layout=None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Analytic congestion-model delay of fixed routes
     (`gnn_offloading_agent.py:333-374`).  Returns (loss, unit_edge).
@@ -84,7 +91,8 @@ def _critic_loss(
     link_lambda = load[:num_links]
     node_lambda = jnp.where(inst.comp_mask, load[num_links:], 0.0)
 
-    link_mu = interference_fixed_point(inst, link_lambda, fp_fn=fp_fn)
+    link_mu = interference_fixed_point(inst, link_lambda, fp_fn=fp_fn,
+                                       layout=layout)
     l_cong = (link_lambda - link_mu) > 0
     link_delay = jnp.where(
         l_cong,
@@ -107,6 +115,63 @@ def _critic_loss(
     prod = jnp.where(routes_inc > 0, unit_edge[:, None] * routes_inc, 0.0)
     delay_job_edge = jnp.maximum(data[None, :] * prod, routes_inc)
     return jnp.sum(delay_job_edge), unit_edge
+
+
+def _critic_loss_steps(
+    inst: Instance, jobs: JobSet, r_steps: jnp.ndarray,
+    seq_slot: jnp.ndarray, dst: jnp.ndarray, fp_fn=None, layout=None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Step-indexed twin of `_critic_loss` for the sparse layout.
+
+    Differentiated w.r.t. `r_steps` (H+1, J): rows [0, H) are the route-step
+    occupancies (1.0 at active steps), row H the destination pseudo-link
+    occupancy (1.0 for real jobs).  The (E, J) incidence is a linear scatter
+    of these steps onto DISJOINT (slot, job) entries (greedy routes are
+    simple — no link is revisited), so d loss / d r_steps equals the dense
+    incidence gradient gathered at the route positions: exactly the values
+    the suffix-bias walk consumes.  The (E, J) matrix never materializes.
+    """
+    num_links = inst.num_pad_links
+    n = inst.num_pad_nodes
+    dt = island_dtype(r_steps.dtype, jobs.rate.dtype)
+    r_steps = r_steps.astype(dt)
+    steps, occ_d = r_steps[:-1], r_steps[-1]                     # (H,J), (J,)
+    w = jnp.where(jobs.mask, jobs.rate.astype(dt) * jobs.ul.astype(dt), 0.0)
+    link_lambda = jnp.zeros((num_links,), dt).at[seq_slot].add(
+        steps * w[None, :]
+    )
+    node_lambda = jnp.where(
+        inst.comp_mask, jnp.zeros((n,), dt).at[dst].add(occ_d * w), 0.0
+    )
+
+    link_mu = interference_fixed_point(inst, link_lambda, fp_fn=fp_fn,
+                                       layout=layout)
+    l_cong = (link_lambda - link_mu) > 0
+    link_delay = jnp.where(
+        l_cong,
+        inst.T * link_lambda / (101.0 * link_mu),
+        1.0 / jnp.where(l_cong, 1.0, link_mu - link_lambda),
+    )
+    node_mu = jnp.where(inst.comp_mask, inst.proc_bws, 1.0)
+    n_cong = ((node_lambda - node_mu) > 0) & inst.comp_mask
+    node_delay = jnp.where(
+        n_cong,
+        inst.T * node_lambda / (100.0 * node_mu),
+        1.0 / jnp.where(n_cong, 1.0, node_mu - node_lambda),
+    )
+    node_delay = jnp.where(inst.comp_mask, node_delay, 0.0)
+
+    # per-(step, job) delay terms — inactive steps (occupancy 0) contribute
+    # max(0, 0) = 0, exactly like the dense (E, J) zero entries
+    data = jobs.ul.astype(dt) + jobs.dl.astype(dt)               # (J,)
+    unit_h = link_delay[seq_slot]                                # (H, J)
+    prod = jnp.where(steps > 0, unit_h * steps, 0.0)
+    term = jnp.maximum(data[None, :] * prod, steps)
+    unit_d = node_delay[dst]                                     # (J,)
+    prod_d = jnp.where(occ_d > 0, unit_d * occ_d, 0.0)
+    term_d = jnp.maximum(data * prod_d, occ_d)
+    unit_edge = jnp.concatenate([link_delay, node_delay])        # (E,)
+    return jnp.sum(term) + jnp.sum(term_d), unit_edge
 
 
 def _suffix_bias_grad(
@@ -148,6 +213,34 @@ def _suffix_bias_grad(
     return grad_edge.sum(axis=1)                                 # (E,)
 
 
+def _suffix_bias_grad_steps(
+    inst: Instance,
+    jobs: JobSet,
+    routes: RouteSet,
+    grad_steps: jnp.ndarray,
+) -> jnp.ndarray:
+    """`_suffix_bias_grad` from the step-form cotangent.
+
+    `grad_steps` (H+1, J) = d loss / d r_steps is already the incidence
+    gradient gathered along each route (see `_critic_loss_steps`), so the
+    prefix-sum walk needs no (E, J) gather — and because the caller only
+    wants the per-slot total, the scatter lands directly in the (E,) vector
+    (the dense path's `grad_edge.sum(axis=1)` fused into the scatter-add).
+    """
+    num_slots = inst.num_pad_links + inst.num_pad_nodes
+    dtg = grad_steps.dtype
+    a = routes.seq_active.astype(dtg)                            # (H, J)
+    picked = grad_steps[:-1] * a                                 # (H, J)
+    cum = -jnp.cumsum(picked, axis=0)                            # (H, J)
+    am = jobs.mask.astype(dtg)
+    cum_end = cum[-1] - grad_steps[-1] * am
+    pseudo = inst.num_pad_links + routes.dst
+    ge = jnp.zeros((num_slots,), dtg).at[
+        routes.seq_slot.reshape(-1)
+    ].add((cum * a).reshape(-1))
+    return ge.at[pseudo].add(cum_end * am)                       # (E,)
+
+
 def _grad_edge_to_distance(
     inst: Instance, grad_edge: jnp.ndarray
 ) -> jnp.ndarray:
@@ -180,10 +273,12 @@ def forward_backward(
     fp_fn=None,
     dropout_rng: jax.Array | None = None,
     compat_diagonal_bug: bool = False,
+    layout=None,
 ) -> TrainStepOutput:
+    lay = resolve_layout(layout)
     if support is None:
-        support = default_support(model, inst)
-    apsp = apsp_fn or apsp_minplus
+        support = default_support(model, inst, layout=lay)
+    apsp = apsp_fn or (apsp_minplus_blocked if lay.sparse else apsp_minplus)
 
     # --- 1. actor forward under VJP -------------------------------------
     # dropout active iff a dropout key is supplied (the reference applies
@@ -193,7 +288,7 @@ def forward_backward(
         out = actor_delay_matrix(
             model, params_tree, inst, jobs, support,
             deterministic=dropout_rng is None, dropout_rng=dropout_rng,
-            fp_fn=fp_fn,
+            fp_fn=fp_fn, layout=lay,
         )
         return out.delay_matrix, out
 
@@ -210,27 +305,55 @@ def forward_backward(
         )
     else:
         unit_diag = lax.stop_gradient(jnp.diagonal(dmtx))
-    w = weight_matrix_from_link_delays(inst.adj, inst.link_index, link_delay)
+    if lay.sparse:
+        w = weight_matrix_from_edges(
+            inst.link_ends, inst.link_mask, link_delay, inst.num_pad_nodes
+        )
+    else:
+        w = weight_matrix_from_link_delays(
+            inst.adj, inst.link_index, link_delay
+        )
     sp = apsp(w)
     # hop counts are topology-only and precomputed at Instance build time
     # (the reference recomputes Dijkstra hops per call, `:304-305`)
     dec = offload_decide(inst, jobs, sp, inst.hop, unit_diag, key, explore, prob)
-    routes = trace_routes(inst, next_hop_table(inst.adj, sp), jobs, dec.dst)
-    delays = run_empirical(inst, jobs, routes, fp_fn=fp_fn)
+    nh = (next_hop_from_edges(inst.link_ends, inst.link_mask, sp)
+          if lay.sparse else next_hop_table(inst.adj, sp))
+    routes = trace_routes(inst, nh, jobs, dec.dst, with_inc=not lay.sparse)
+    delays = run_empirical(inst, jobs, routes, fp_fn=fp_fn, layout=lay)
 
     # --- 3. critic gradient w.r.t. routes -------------------------------
     # fp32-island(fixed_point): differentiate from a wide incidence so
     # grad_routes — and the whole suffix-bias chain it feeds — carries
     # fp32 gradient signal even when routes are stored bf16
-    routes_inc_wide = routes.inc_ext.astype(island_dtype(routes.inc_ext.dtype))
-    (loss_critic, unit_edge), grad_routes = jax.value_and_grad(
-        lambda r: _critic_loss(inst, jobs, r, fp_fn=fp_fn), has_aux=True
-    )(routes_inc_wide)
+    if lay.sparse:
+        # step-form critic: differentiate over the (H+1, J) route-step
+        # occupancies instead of the (E, J) incidence (same gradient — the
+        # incidence is a linear scatter of the steps onto disjoint entries)
+        wdt = island_dtype(inst.link_rates.dtype)
+        r_steps = jnp.concatenate(
+            [routes.seq_active.astype(wdt), jobs.mask.astype(wdt)[None, :]],
+            axis=0,
+        )
+        (loss_critic, unit_edge), grad_steps = jax.value_and_grad(
+            lambda r: _critic_loss_steps(inst, jobs, r, routes.seq_slot,
+                                         dec.dst, fp_fn=fp_fn, layout=lay),
+            has_aux=True,
+        )(r_steps)
+        grad_edge = _suffix_bias_grad_steps(inst, jobs, routes, grad_steps)
+    else:
+        routes_inc_wide = routes.inc_ext.astype(
+            island_dtype(routes.inc_ext.dtype)
+        )
+        (loss_critic, unit_edge), grad_routes = jax.value_and_grad(
+            lambda r: _critic_loss(inst, jobs, r, fp_fn=fp_fn, layout=lay),
+            has_aux=True,
+        )(routes_inc_wide)
+        grad_edge = _suffix_bias_grad(inst, jobs, routes, grad_routes)
 
     # --- 4. suffix-bias gradient onto unit delays -----------------------
     # (critic_weight scales the reference's policy-sensitivity term; 1.0 is
     # reference behavior, 0.0 trains on the MSE supervision alone)
-    grad_edge = _suffix_bias_grad(inst, jobs, routes, grad_routes)
     grad_dist = critic_weight * _grad_edge_to_distance(inst, grad_edge)
 
     # --- 5. MSE supervision on written entries (`:440-444`) -------------

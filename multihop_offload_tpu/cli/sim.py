@@ -29,6 +29,7 @@ def _make_gnn_policy(cfg: Config, pad):
     import jax
     import jax.numpy as jnp
 
+    from multihop_offload_tpu.layouts import zeros_support
     from multihop_offload_tpu.models import make_model
     from multihop_offload_tpu.sim.policies import make_policy
     from multihop_offload_tpu.train import checkpoints as ckpt_lib
@@ -37,7 +38,7 @@ def _make_gnn_policy(cfg: Config, pad):
     variables = model.init(
         jax.random.PRNGKey(cfg.seed),
         jnp.zeros((pad.e, 4), cfg.jnp_dtype),
-        jnp.zeros((pad.e, pad.e), cfg.jnp_dtype),
+        zeros_support(pad, cfg.jnp_dtype, cfg.layout_policy),
     )
     loaded = None
     try:
@@ -59,7 +60,8 @@ def _make_gnn_policy(cfg: Config, pad):
           + (f"checkpoint step {loaded}" if loaded is not None
              else "fresh-init weights"))
     return make_policy("gnn", model=model, variables=variables,
-                       precision=cfg.precision_policy)
+                       precision=cfg.precision_policy,
+                       layout=cfg.layout_policy)
 
 
 def run_scenarios(cfg: Config, steady: bool = True) -> dict:
@@ -92,8 +94,11 @@ def run_scenarios(cfg: Config, steady: bool = True) -> dict:
         s=cfg.round_to,
         j=max(cfg.sim_jobs, cfg.round_to),
     )
+    lay = cfg.layout_policy
     keys = jax.random.split(jax.random.PRNGKey(cfg.seed), fleet)
-    bp = jax.jit(baseline_policy)
+    bp = jax.jit(
+        lambda inst, jobs, key: baseline_policy(inst, jobs, key, layout=lay)
+    )
     total_slots = cfg.sim_rounds * cfg.sim_slots
     fail_slot = total_slots // 2
     rng = np.random.default_rng(cfg.seed)
@@ -101,7 +106,7 @@ def run_scenarios(cfg: Config, steady: bool = True) -> dict:
     cases, params_list = [], []
     for i in range(fleet):
         inst, jobs = make_case(
-            cfg.seed + 100 * i, topos[i], pad, cfg.sim_jobs
+            cfg.seed + 100 * i, topos[i], pad, cfg.sim_jobs, layout=lay
         )
         jobs, _ = scale_to_util(inst, jobs, keys[i], cfg.sim_util,
                                 policy_fn=bp)
@@ -131,7 +136,8 @@ def run_scenarios(cfg: Config, steady: bool = True) -> dict:
     if cfg.sim_policy == "gnn":
         policy = _make_gnn_policy(cfg, pad)
     else:
-        policy = make_policy(cfg.sim_policy, precision=cfg.precision_policy)
+        policy = make_policy(cfg.sim_policy, precision=cfg.precision_policy,
+                             layout=lay)
 
     inst0, jobs0 = cases[0]
     spec = spec_for(inst0, jobs0, cap=cfg.sim_cap)

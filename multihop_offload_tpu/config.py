@@ -77,6 +77,19 @@ class Config:
     #                                costs, Laplacian constants); auto =
     #                                bf16 on a TPU backend, fp32 elsewhere.
     #                                See docs/OPERATIONS.md "Precision".
+    layout: str = "dense"          # instance memory layout:
+    #                                dense | sparse | auto.  dense = the
+    #                                (N, N)/(L, L) matrix layout — the parity
+    #                                reference and the default until the
+    #                                layout_ab on-chip gates pass; sparse =
+    #                                pad-to-static edge lists + segment
+    #                                reductions (layouts/ module: edge-list
+    #                                ChebConv, gathered delay math, compact
+    #                                int16 indices); auto = sparse on a TPU
+    #                                backend, dense elsewhere.  Resolved once
+    #                                at build time (never retraces a steady
+    #                                program).  See docs/OPERATIONS.md
+    #                                "Layouts".
     apsp_impl: str = "xla"         # all-pairs-shortest-path kernel for the
     #                                decision paths: xla | pallas | auto.
     #                                auto = fastest measured path per shape
@@ -229,6 +242,15 @@ class Config:
         from multihop_offload_tpu.precision import resolve_precision
 
         return resolve_precision(self.precision, self.jnp_dtype)
+
+    @property
+    def layout_policy(self):
+        """The resolved `multihop_offload_tpu.layouts.LayoutPolicy` for this
+        config — same build-time contract as `precision_policy`: resolved
+        once per consumer, baked into closures, never traced."""
+        from multihop_offload_tpu.layouts import resolve_layout
+
+        return resolve_layout(self.layout)
 
     def model_dir(self, root: Optional[str] = None) -> str:
         """Checkpoint directory; naming mirrors `AdHoc_train.py:59`."""

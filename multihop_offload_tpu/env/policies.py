@@ -16,6 +16,7 @@ from flax import struct
 from multihop_offload_tpu.graphs.instance import Instance, JobSet
 from multihop_offload_tpu.env.apsp import (
     apsp_minplus,
+    apsp_minplus_blocked,
     next_hop_table,
     weight_matrix_from_link_delays,
 )
@@ -23,6 +24,11 @@ from multihop_offload_tpu.env.baseline import baseline_unit_delays
 from multihop_offload_tpu.env.offloading import OffloadDecision, offload_decide
 from multihop_offload_tpu.env.queueing import EmpiricalDelays, run_empirical
 from multihop_offload_tpu.env.routing import RouteSet, trace_routes
+from multihop_offload_tpu.layouts import (
+    next_hop_from_edges,
+    resolve_layout,
+    weight_matrix_from_edges,
+)
 
 
 @struct.dataclass
@@ -46,6 +52,7 @@ def evaluate_spmatrix_policy(
     prob: bool = False,
     apsp_fn=None,
     fp_fn=None,
+    layout=None,
 ) -> PolicyOutcome:
     """Offload + route + run given per-link unit delays and a node diagonal.
 
@@ -55,37 +62,60 @@ def evaluate_spmatrix_policy(
     min-plus APSP + hop counts, take the greedy decision, trace routes, and
     score empirically.  `apsp_fn` overrides the APSP kernel (e.g. the
     mesh-sharded ring variant from `parallel.ring` for large graphs).
+
+    Under `layout=sparse` the weight matrix is scatter-built from the link
+    list, the next-hop table comes from a directed-edge segment-min, and the
+    min-plus APSP runs k-blocked (`apsp_minplus_blocked`) — all three
+    BIT-IDENTICAL to their dense twins, so the decisions here never depend
+    on the layout knob.  The all-pairs OUTPUT is inherently (N, N); what the
+    sparse layout removes is the (N, N, N) squaring temp.
     """
-    apsp = apsp_fn or apsp_minplus
-    w = weight_matrix_from_link_delays(inst.adj, inst.link_index, link_delays)
+    lay = resolve_layout(layout)
+    apsp = apsp_fn or (apsp_minplus_blocked if lay.sparse else apsp_minplus)
+    if lay.sparse:
+        w = weight_matrix_from_edges(
+            inst.link_ends, inst.link_mask, link_delays, inst.num_pad_nodes
+        )
+    else:
+        w = weight_matrix_from_link_delays(
+            inst.adj, inst.link_index, link_delays
+        )
     sp = apsp(w)
     # hop counts are topology-only and precomputed at Instance build time
     dec = offload_decide(inst, jobs, sp, inst.hop, unit_diag, key, explore, prob)
-    nh = next_hop_table(inst.adj, sp)
+    if lay.sparse:
+        nh = next_hop_from_edges(inst.link_ends, inst.link_mask, sp)
+    else:
+        nh = next_hop_table(inst.adj, sp)
     routes = trace_routes(inst, nh, jobs, dec.dst)
-    delays = run_empirical(inst, jobs, routes, fp_fn=fp_fn)
+    delays = run_empirical(inst, jobs, routes, fp_fn=fp_fn, layout=lay)
     return PolicyOutcome(decision=dec, routes=routes, delays=delays)
 
 
 def baseline_policy(
     inst: Instance, jobs: JobSet, key: jax.Array, explore=0.0, prob: bool = False,
-    apsp_fn=None, fp_fn=None,
+    apsp_fn=None, fp_fn=None, layout=None,
 ) -> PolicyOutcome:
     """Congestion-agnostic greedy offloading (`AdHoc_train.py:128-141`)."""
     link_d, node_d = baseline_unit_delays(inst)
     return evaluate_spmatrix_policy(
         inst, jobs, link_d, node_d, key, explore, prob, apsp_fn=apsp_fn,
-        fp_fn=fp_fn,
+        fp_fn=fp_fn, layout=layout,
     )
 
 
-def local_policy(inst: Instance, jobs: JobSet, fp_fn=None) -> PolicyOutcome:
+def local_policy(
+    inst: Instance, jobs: JobSet, fp_fn=None, layout=None
+) -> PolicyOutcome:
     """Everything computes at its source (`local_compute`,
     `offloading_v3.py:363-386`)."""
     _, node_d = baseline_unit_delays(inst)
     num_jobs = jobs.src.shape[0]
+    # src may be stored compact (int16 under the sparse layout) — decisions
+    # and routes carry int32 node ids everywhere else
+    src32 = jobs.src.astype(jnp.int32)
     dec = OffloadDecision(
-        dst=jobs.src,
+        dst=src32,
         is_local=jnp.ones((num_jobs,), bool),
         delay_est=jnp.maximum(node_d[jobs.src] * jobs.ul, 1.0),
         costs=jnp.zeros((num_jobs, inst.servers.shape[0] + 1), node_d.dtype),
@@ -93,15 +123,15 @@ def local_policy(inst: Instance, jobs: JobSet, fp_fn=None) -> PolicyOutcome:
     # no links traversed: an identity "route" of zero hops
     horizon = inst.num_pad_nodes
     routes = RouteSet(
-        dst=jobs.src,
+        dst=src32,
         nhop=jnp.zeros((num_jobs,), node_d.dtype),
         seq_slot=jnp.zeros((horizon, num_jobs), jnp.int32),
         seq_active=jnp.zeros((horizon, num_jobs), bool),
         inc_ext=jnp.zeros(
             (inst.num_pad_links + inst.num_pad_nodes, num_jobs), node_d.dtype
-        ).at[inst.num_pad_links + jobs.src, jnp.arange(num_jobs)].add(
+        ).at[inst.num_pad_links + src32, jnp.arange(num_jobs)].add(
             jobs.mask.astype(node_d.dtype)
         ),
     )
-    delays = run_empirical(inst, jobs, routes, fp_fn=fp_fn)
+    delays = run_empirical(inst, jobs, routes, fp_fn=fp_fn, layout=layout)
     return PolicyOutcome(decision=dec, routes=routes, delays=delays)

@@ -14,16 +14,24 @@ array math:
 Also emits the (N, N) empirical unit-delay matrix + written-entry mask the
 training MSE term supervises against (`:508,540-548`), with the reference's
 last-write-wins job ordering.
+
+Under `layout=sparse` the (L, J) incidence and (L, L) conflict matmuls are
+replaced by gathers/segment reductions over the realized route steps and the
+conflict edge list (`layouts.SparseInstance`) — no (L, J)/(L, L)/(N, N)
+intermediates beyond the supervised unit matrix itself.  Dense stays the
+parity reference; tests/test_layouts.py pins decision agreement at 1.0.
 """
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 from flax import struct
 from jax import lax
 
 from multihop_offload_tpu.graphs.instance import Instance, JobSet
 from multihop_offload_tpu.env.routing import RouteSet
+from multihop_offload_tpu.layouts import resolve_layout
 from multihop_offload_tpu.precision import island_dtype
 
 
@@ -66,7 +74,8 @@ def interference_fixed_point_raw(
 
 
 def interference_fixed_point(
-    inst: Instance, link_lambda: jnp.ndarray, num_iters: int = 10, fp_fn=None
+    inst: Instance, link_lambda: jnp.ndarray, num_iters: int = 10, fp_fn=None,
+    layout=None,
 ) -> jnp.ndarray:
     """Converged per-link service rates mu under conflict coupling.
 
@@ -83,8 +92,32 @@ def interference_fixed_point(
     — the XLA scan and the Pallas kernel alike then iterate wide, and the
     returned mu keeps downstream delay math wide by dtype promotion.  A
     no-op under the identity (fp32/fp64) policy.
+
+    Under the sparse layout (and no `fp_fn` override — the Pallas kernel
+    stays dense in VMEM), the (L, L) neighbor-busyness matvec runs as a
+    segment-sum over the conflict edge list (`inst.sparse.cf`), never
+    materializing the conflict matrix.  Same update, same iteration count,
+    same fp32 island; only the reduction association differs.
     """
     dt = island_dtype(link_lambda.dtype, inst.link_rates.dtype)
+    lay = resolve_layout(layout)
+    if fp_fn is None and lay.sparse and inst.sparse is not None:
+        cf = inst.sparse.cf
+        rates = inst.link_rates.astype(dt)
+        lam = link_lambda.astype(dt)
+        cf_vals = cf.vals.astype(dt)
+        num_links = rates.shape[0]
+        mu0 = rates / (inst.cf_degs.astype(dt) + 1.0)
+
+        def body(mu, _):
+            busy = jnp.clip(lam / mu, 0.0, 1.0)
+            neighbor_busy = jax.ops.segment_sum(
+                cf_vals * busy[cf.cols], cf.rows, num_segments=num_links
+            )
+            return rates / (1.0 + neighbor_busy), None
+
+        mu, _ = lax.scan(body, mu0, None, length=num_iters)
+        return mu
     fp = fp_fn or interference_fixed_point_raw
     return fp(
         inst.adj_conflict.astype(dt), inst.link_rates.astype(dt),
@@ -93,19 +126,20 @@ def interference_fixed_point(
 
 
 def run_empirical(
-    inst: Instance, jobs: JobSet, routes: RouteSet, fp_fn=None
+    inst: Instance, jobs: JobSet, routes: RouteSet, fp_fn=None, layout=None
 ) -> EmpiricalDelays:
     num_links = inst.num_pad_links
     n = inst.num_pad_nodes
+    lay = resolve_layout(layout)
+    sparse = lay.sparse
     # fp32-island(delay_reduction): the arrival accumulation, every
     # 1/(mu - lambda) unit delay, and the per-job totals run >= fp32 —
     # bf16 routes/rates feed in, wide EmpiricalDelays come out.  lambda
     # accuracy feeds the fixed point's denominators directly, so the
     # incidence matmul is re-accumulated wide, not just its result.
-    dt = island_dtype(
-        routes.inc_ext.dtype, jobs.rate.dtype, inst.link_rates.dtype
-    )
-    inc = routes.inc_ext[:num_links].astype(dt)   # (L, J)
+    inc_dt = (routes.inc_ext.dtype if routes.inc_ext is not None
+              else inst.link_rates.dtype)  # inc may be skipped (train sparse)
+    dt = island_dtype(inc_dt, jobs.rate.dtype, inst.link_rates.dtype)
     jmask = jobs.mask
     ul = jobs.ul.astype(dt)
     dl = jobs.dl.astype(dt)
@@ -113,29 +147,61 @@ def run_empirical(
     ul_rate = ul * jobs.rate.astype(dt)
     dl_rate = dl * jobs.rate.astype(dt)
 
-    link_lambda = inc @ (ul_rate + dl_rate)       # (L,)  (`:494`)
+    if sparse:
+        # Route-step form: seq_slot/seq_active hold the realized (hop, job)
+        # link ids, so the (L, J) incidence never materializes.  Routes are
+        # simple (trace_routes walks a greedy next-hop table, horizon N), so
+        # per-step accumulation == per-traversed-link-once, same as `inc`.
+        inc = None
+        seq = routes.seq_slot                             # (H, J)
+        act = routes.seq_active                           # (H, J) bool
+        step_rate = jnp.where(act, (ul_rate + dl_rate)[None, :], 0.0)
+        link_lambda = (
+            jnp.zeros((num_links,), dt).at[seq].add(step_rate)
+        )                                                 # (`:494`)
+    else:
+        inc = routes.inc_ext[:num_links].astype(dt)       # (L, J)
+        link_lambda = inc @ (ul_rate + dl_rate)           # (`:494`)
     server_load = jnp.zeros((n,), dtype=ul_rate.dtype).at[routes.dst].add(
         jnp.where(jmask, ul_rate, 0.0)
-    )                                             # (`:496`)
+    )                                                     # (`:496`)
 
-    link_mu = interference_fixed_point(inst, link_lambda, fp_fn=fp_fn)
+    link_mu = interference_fixed_point(inst, link_lambda, fp_fn=fp_fn,
+                                       layout=lay)
 
     # per-(link, job) unit delay with per-job congestion fallback (`:537-539`)
     slack = link_mu - link_lambda                 # (L,)
     congested_l = slack <= 0.0
     safe_slack = jnp.where(congested_l, 1.0, slack)
     unit_ok = 1.0 / safe_slack
-    unit_cong = inst.T * link_lambda[:, None] / (
-        (ul + dl)[None, :] * link_mu[:, None]
-    )
-    unit_lj = jnp.where(congested_l[:, None], unit_cong, unit_ok[:, None])
 
-    # per-link per-job empirical delay, only on traversed links (`:542`)
-    d_ul = jnp.maximum(ul[None, :] * unit_lj, nhop[None, :])
-    d_dl = jnp.maximum(dl[None, :] * unit_lj, nhop[None, :])
-    # untraversed (link, job) pairs may hold inf/NaN (e.g. zero-rate links the
-    # reference simply never visits) — mask before summing, don't multiply
-    job_link = jnp.sum(jnp.where(inc > 0, d_ul + d_dl, 0.0), axis=0)
+    if sparse:
+        # gather the per-link quantities at each realized route step and
+        # reduce over hops — (H, J) intermediates, H = horizon, not (L, J)
+        lam_h = link_lambda[seq]
+        mu_h = link_mu[seq]
+        cong_h = congested_l[seq]
+        unit_h = jnp.where(
+            cong_h,
+            inst.T * lam_h / ((ul + dl)[None, :] * mu_h),
+            unit_ok[seq],
+        )
+        d_ul_h = jnp.maximum(ul[None, :] * unit_h, nhop[None, :])
+        d_dl_h = jnp.maximum(dl[None, :] * unit_h, nhop[None, :])
+        job_link = jnp.sum(jnp.where(act, d_ul_h + d_dl_h, 0.0), axis=0)
+    else:
+        unit_cong = inst.T * link_lambda[:, None] / (
+            (ul + dl)[None, :] * link_mu[:, None]
+        )
+        unit_lj = jnp.where(congested_l[:, None], unit_cong, unit_ok[:, None])
+
+        # per-link per-job empirical delay, only on traversed links (`:542`)
+        d_ul = jnp.maximum(ul[None, :] * unit_lj, nhop[None, :])
+        d_dl = jnp.maximum(dl[None, :] * unit_lj, nhop[None, :])
+        # untraversed (link, job) pairs may hold inf/NaN (e.g. zero-rate links
+        # the reference simply never visits) — mask before summing, don't
+        # multiply
+        job_link = jnp.sum(jnp.where(inc > 0, d_ul + d_dl, 0.0), axis=0)
 
     # server component (`:545-549`)
     bw = inst.proc_bws[routes.dst].astype(dt)
@@ -154,33 +220,56 @@ def run_empirical(
     total = job_link + job_server
 
     # ---- empirical unit-delay matrix, last-write-wins over job order -------
-    def write(carry, j):
-        u_link, u_node = carry
-        on_route = inc[:, j] > 0
-        u_link = jnp.where(on_route, unit_lj[:, j], u_link)
-        u_node = jnp.where(
-            jmask[j],
-            u_node.at[routes.dst[j]].set(unit_s[j]),
-            u_node,
+    if sparse:
+        # "last write wins" == highest job index among a link's/node's
+        # writers: one segment-max of job ids over route steps replaces the
+        # dense scan over jobs, and the winner's unit delay is recomputed
+        # from the per-link scalars (identical to unit_lj at that column).
+        jidx = jnp.arange(jobs.src.shape[0], dtype=jnp.int32)
+        jwin = jnp.full((num_links,), -1, jnp.int32).at[seq].max(
+            jnp.where(act, jidx[None, :], -1)
         )
-        return (u_link, u_node), None
+        link_written = jwin >= 0
+        jw = jnp.maximum(jwin, 0)
+        u_link = jnp.where(
+            congested_l,
+            inst.T * link_lambda / ((ul + dl)[jw] * link_mu),
+            unit_ok,
+        )
+        nwin = jnp.full((n,), -1, jnp.int32).at[routes.dst].max(
+            jnp.where(jmask, jidx, -1)
+        )
+        node_written = nwin >= 0
+        u_node = unit_s[jnp.maximum(nwin, 0)]
+    else:
+        def write(carry, j):
+            u_link, u_node = carry
+            on_route = inc[:, j] > 0
+            u_link = jnp.where(on_route, unit_lj[:, j], u_link)
+            u_node = jnp.where(
+                jmask[j],
+                u_node.at[routes.dst[j]].set(unit_s[j]),
+                u_node,
+            )
+            return (u_link, u_node), None
 
-    (u_link, u_node), _ = lax.scan(
-        write,
-        (jnp.zeros((num_links,), total.dtype), jnp.zeros((n,), total.dtype)),
-        jnp.arange(jobs.src.shape[0]),
-    )
-    link_written = (inc @ jnp.where(jmask, 1.0, 0.0)) > 0
-    node_written = jnp.zeros((n,), bool).at[routes.dst].max(jmask)
+        (u_link, u_node), _ = lax.scan(
+            write,
+            (jnp.zeros((num_links,), total.dtype),
+             jnp.zeros((n,), total.dtype)),
+            jnp.arange(jobs.src.shape[0]),
+        )
+        link_written = (inc @ jnp.where(jmask, 1.0, 0.0)) > 0
+        node_written = jnp.zeros((n,), bool).at[routes.dst].max(jmask)
 
     u, v = inst.link_ends[:, 0], inst.link_ends[:, 1]
-    unit_matrix = jnp.zeros((n, n), total.dtype)
+    unit_matrix = jnp.zeros((n, n), total.dtype)  # dense-ok(train target: the (N, N) unit-delay matrix IS the supervised output)
     unit_matrix = unit_matrix.at[u, v].set(jnp.where(link_written, u_link, 0.0))
     unit_matrix = unit_matrix.at[v, u].max(jnp.where(link_written, u_link, 0.0))
     unit_matrix = unit_matrix.at[jnp.arange(n), jnp.arange(n)].set(
         jnp.where(node_written, u_node, 0.0)
     )
-    unit_mask = jnp.zeros((n, n), bool)
+    unit_mask = jnp.zeros((n, n), bool)  # dense-ok(train target mask, same shape as the supervised unit matrix)
     unit_mask = unit_mask.at[u, v].max(link_written)
     unit_mask = unit_mask.at[v, u].max(link_written)
     unit_mask = unit_mask.at[jnp.arange(n), jnp.arange(n)].max(node_written)

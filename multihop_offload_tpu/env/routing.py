@@ -29,6 +29,9 @@ class RouteSet:
     inc_ext: jnp.ndarray     # (E, J) 0/1 incidence incl. final pseudo-link
     #                          (the critic's `routes` matrix); slots [0, L)
     #                          are real links — slice with `link_incidence`.
+    #                          None when traced with `with_inc=False` (the
+    #                          sparse-layout train path works entirely from
+    #                          the step sequence).
 
 
 def trace_routes(
@@ -36,12 +39,17 @@ def trace_routes(
     next_hop: jnp.ndarray,
     jobs: JobSet,
     dst: jnp.ndarray,
+    with_inc: bool = True,
 ) -> RouteSet:
     """Walk every job's greedy route src -> dst simultaneously.
 
     `next_hop`: (N, N) table from `env.apsp.next_hop_table`.  Local jobs
     (dst == src) traverse no links.  Padded jobs contribute nothing (their
     incidence column is zeroed by the job mask).
+
+    `with_inc=False` skips the (E, J) incidence scatter and sets
+    `inc_ext=None` — the sparse-layout train path consumes routes purely
+    as the (H, J) step sequence.
     """
     n = inst.num_pad_nodes
     num_links = inst.num_pad_links
@@ -59,7 +67,10 @@ def trace_routes(
 
     (final_node, nhop), (seq_link, seq_active) = lax.scan(
         step,
-        (jobs.src, jnp.zeros((num_jobs,), dtype=inst.link_rates.dtype)),
+        # src may be stored compact (int16 under the sparse layout); the
+        # carry must match the int32 next-hop gather the body emits
+        (jobs.src.astype(jnp.int32),
+         jnp.zeros((num_jobs,), dtype=inst.link_rates.dtype)),
         None,
         length=horizon,
     )
@@ -70,14 +81,18 @@ def trace_routes(
     # incidence over extended slots: real links from the step sequence,
     # then the compute pseudo-link at the destination for every real job
     # (reference `routes_np`, gnn_offloading_agent.py:310-331).
-    cols = jnp.broadcast_to(jnp.arange(num_jobs)[None, :], seq_slot.shape)
-    inc = jnp.zeros(
-        (num_links + n, num_jobs), dtype=inst.link_rates.dtype
-    ).at[seq_slot.reshape(-1), cols.reshape(-1)].add(
-        seq_active.reshape(-1).astype(inst.link_rates.dtype)
-    )
-    pseudo = num_links + dst
-    inc = inc.at[pseudo, jnp.arange(num_jobs)].add(jobs.mask.astype(inc.dtype))
+    inc = None
+    if with_inc:
+        cols = jnp.broadcast_to(jnp.arange(num_jobs)[None, :], seq_slot.shape)
+        inc = jnp.zeros(
+            (num_links + n, num_jobs), dtype=inst.link_rates.dtype
+        ).at[seq_slot.reshape(-1), cols.reshape(-1)].add(
+            seq_active.reshape(-1).astype(inst.link_rates.dtype)
+        )
+        pseudo = num_links + dst
+        inc = inc.at[pseudo, jnp.arange(num_jobs)].add(
+            jobs.mask.astype(inc.dtype)
+        )
 
     return RouteSet(
         dst=dst,

@@ -28,16 +28,36 @@ from multihop_offload_tpu.graphs.topology import Topology
 
 @dataclasses.dataclass(frozen=True)
 class PadSpec:
-    """Static pad sizes. E (extended slots) is always L + N by construction."""
+    """Static pad sizes. E (extended slots) is always L + N by construction.
+
+    `enn` / `cnn` bound the sparse layout's edge-list pads (nonzeros of the
+    extended / conflict adjacency).  0 means "use the heuristic default" —
+    generous for the BA workload graphs; builders RAISE (never truncate)
+    when a graph exceeds the bound, and `dataclasses.replace(pad, enn=...)`
+    sets an exact bound computed from data (`layouts.ext_nnz_count`).
+    Dense-layout programs never read them.
+    """
 
     n: int          # nodes
     l: int          # links
     s: int          # servers
     j: int          # jobs
+    enn: int = 0    # extended-adjacency nnz pad (0 = heuristic default)
+    cnn: int = 0    # conflict-adjacency nnz pad (0 = heuristic default)
 
     @property
     def e(self) -> int:
         return self.l + self.n
+
+    @property
+    def ext_nnz(self) -> int:
+        # line-graph entries scale with sum(deg^2); 16 * E covers the BA
+        # workload with slack (measured ~3.4k real vs 5.4k pad at N=110)
+        return self.enn if self.enn > 0 else self.round_up(16 * self.e, 128)
+
+    @property
+    def cf_nnz(self) -> int:
+        return self.cnn if self.cnn > 0 else self.round_up(16 * self.l, 128)
 
     @staticmethod
     def round_up(x: int, to: int) -> int:
@@ -86,6 +106,12 @@ class Instance:
     hop: np.ndarray          # (N, N) float hop counts (inf unreachable, 0 diag)
     # scalars
     T: np.ndarray            # () float congestion-penalty scale
+    # sparse layout twin (layouts.SparseInstance): edge lists padded to the
+    # PadSpec nnz bounds.  None under the dense layout — an EMPTY pytree
+    # subtree, so stacking/vmap/jit are unaffected; sparse-layout programs
+    # read these and leave the dense structural leaves to jit's unused-
+    # argument pruning (that pruning IS the argument-bytes win).
+    sparse: Optional[object] = None
 
     @property
     def num_pad_nodes(self) -> int:
@@ -122,6 +148,7 @@ def build_instance(
     dtype=np.float32,
     hop: Optional[np.ndarray] = None,
     device: bool = True,
+    layout=None,
 ) -> Instance:
     """Freeze a topology + resource assignment into a padded Instance.
 
@@ -130,7 +157,14 @@ def build_instance(
     (per-visit link-rate re-realization) can cache it (`compute_hop_matrix`).
     `device=False` keeps numpy leaves so callers that stack many instances
     can ship one batched transfer (`stack_instances`).
+    `layout` (str | LayoutPolicy | None): under the sparse layout the
+    Instance additionally carries edge-list twins of the structural matrices
+    (`inst.sparse`, padded to `pad.ext_nnz`/`pad.cf_nnz`) and packs integer
+    index maps at int16 (compact storage; guarded against overflow).
     """
+    from multihop_offload_tpu.layouts import resolve_layout
+
+    lay = resolve_layout(layout)
     n, l = topo.n, topo.num_links
     N, L, S = pad.n, pad.l, pad.s
     if n > N or l > L:
@@ -156,7 +190,14 @@ def build_instance(
     rates_p[:l] = link_rates
     link_mask = np.zeros((L,), dtype=bool)
     link_mask[:l] = True
-    link_index = np.zeros((N, N), dtype=np.int32)
+    # compact-int satellite: under the sparse layout the (N, N) link-id map
+    # (the one dense int leaf sparse programs still read, for route tracing)
+    # ships at int16 — link ids < L fit 15 bits, guarded at build time
+    link_index = np.zeros((N, N), dtype=lay.index_dtype)
+    if lay.index_dtype != np.int32:
+        assert L - 1 <= np.iinfo(lay.index_dtype).max, (
+            f"link pad {L} overflows {np.dtype(lay.index_dtype).name}"
+        )
     link_index[:n, :n] = np.maximum(topo.link_index, 0)
     adj_cf = np.zeros((L, L), dtype=dtype)
     adj_cf[:l, :l] = topo.adj_conflict
@@ -193,6 +234,14 @@ def build_instance(
     server_mask = np.zeros((S,), dtype=bool)
     server_mask[: server_ids.size] = True
 
+    sparse = None
+    if lay.sparse:
+        from multihop_offload_tpu.layouts import build_sparse_instance
+
+        sparse = build_sparse_instance(
+            adj_ext, adj_cf, pad.ext_nnz, pad.cf_nnz, dtype=dtype
+        )
+
     inst = Instance(
         adj=adj, node_mask=node_mask, roles=roles_p, proc_bws=bws_p,
         comp_mask=comp_mask, link_ends=ends_p, link_rates=rates_p,
@@ -200,7 +249,7 @@ def build_instance(
         cf_degs=cf_degs, adj_ext=adj_ext, ext_rate=ext_rate,
         ext_self_loop=ext_self_loop, ext_as_server=ext_as_server,
         ext_mask=ext_mask, servers=servers, server_mask=server_mask,
-        hop=hop, T=np.asarray(t_max, dtype=dtype),
+        hop=hop, T=np.asarray(t_max, dtype=dtype), sparse=sparse,
     )
     return to_device(inst) if device else inst
 
@@ -228,15 +277,24 @@ def build_jobset(
     dl: float = 1.0,
     dtype=np.float32,
     device: bool = True,
+    index_dtype=np.int32,
 ) -> JobSet:
-    """Pad a concrete workload (job defaults from `offloading_v3.py:132`)."""
-    src = np.asarray(src, dtype=np.int32)
+    """Pad a concrete workload (job defaults from `offloading_v3.py:132`).
+
+    `index_dtype`: storage dtype of the source-node index vector — the
+    sparse layout packs at int16 (`LayoutPolicy.index_dtype`); node ids are
+    guarded against the dtype range at build time."""
+    src = np.asarray(src, dtype=np.int64)
     rate = np.asarray(rate, dtype=dtype)
     j = src.shape[0]
     J = pad_jobs
     if j > J:
         raise ValueError(f"{j} jobs exceed pad {J}")
-    src_p = np.zeros((J,), dtype=np.int32)
+    if j and index_dtype != np.int32:
+        assert int(src.max()) <= np.iinfo(index_dtype).max, (
+            f"job source ids overflow {np.dtype(index_dtype).name}"
+        )
+    src_p = np.zeros((J,), dtype=index_dtype)
     src_p[:j] = src
     rate_p = np.zeros((J,), dtype=dtype)
     rate_p[:j] = rate

@@ -1,0 +1,45 @@
+"""Instance memory layouts: dense (N, N) parity reference vs padded edge lists.
+
+The wireless graphs are sparse (BA, |E| ~ 2N-4N out of N^2 pairs), yet the
+dense layout streams (N, N) Laplacians, (L, L) conflict matrices, and (L, J)
+incidence scatters through HBM every step — BENCH_r05 pins the step at
+arithmetic intensity 0.117.  The `sparse` layout stores graph structure as
+pad-to-static edge lists (arXiv:1906.11786: padded src/dst index vectors +
+segment-sum instead of dense matmul) and rewrites the ChebConv recurrence,
+the per-link arrival/delay reductions, and the next-hop construction as
+gathers + segment reductions.  APSP keeps its (N, N) all-pairs OUTPUT
+(inherently dense) but runs k-blocked min-plus squarings
+(`env.apsp.apsp_minplus_blocked`, bit-identical) so the (N, N, N)
+squaring temp never materializes; its input weight matrix is
+scatter-built on device.
+
+Like `precision`, the knob is resolved ONCE at build time into a frozen
+`LayoutPolicy` baked into closures — switching layouts never retraces a
+steady program, and `dense` remains the default (and the parity reference)
+until the on-chip gates in benchmarks/layout_ab.json pass.
+"""
+
+from multihop_offload_tpu.layouts.policy import (  # noqa: F401
+    LAYOUT_CHOICES,
+    LayoutPolicy,
+    resolve_layout,
+)
+from multihop_offload_tpu.layouts.sparse import (  # noqa: F401
+    SparseInstance,
+    SparseSupport,
+    build_sparse_instance,
+    cf_nnz_count,
+    ext_nnz_count,
+    make_sparse_propagate,
+    next_hop_from_edges,
+    sparse_chebyshev_support,
+    weight_matrix_from_edges,
+    zeros_support,
+)
+from multihop_offload_tpu.layouts.compact import (  # noqa: F401
+    NEXT_HOP_DTYPE,
+    compact_index_dtype,
+    compact_value_dtype,
+    pack_next_hop,
+    unpack_next_hop,
+)

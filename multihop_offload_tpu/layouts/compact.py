@@ -1,0 +1,57 @@
+"""Compact integer storage: int16 next-hop tables, narrow counters.
+
+Graph indices are tiny — N <= a few hundred, streams 2J <= a few hundred —
+yet the dense layout ships them as int32.  These helpers pick the narrowest
+signed dtype a (static) range allows and guard the choice with host-side
+asserts: the bounds are Python ints known at build time, so the guards are
+free in the compiled program and stripped entirely under `python -O`
+("debug mode" overflow guards, per the compact-storage satellite).
+
+int16 is the floor for anything used as a gather/scatter INDEX (XLA
+handles narrow index dtypes fine; int8 buys little and risks surprising
+promotions), while pure value buffers (the simulator's per-packet stream
+ids) may drop to int8 when the range allows.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+NEXT_HOP_DTYPE = np.int16
+
+
+def _guard(name: str, max_value: int, dtype) -> None:
+    # host-side debug assert on a STATIC bound; `python -O` removes it
+    assert int(max_value) <= np.iinfo(dtype).max, (
+        f"{name}: max value {max_value} overflows {np.dtype(dtype).name}"
+    )
+
+
+def compact_index_dtype(max_value: int):
+    """Narrowest signed integer dtype holding [0, max_value] (>= int16 so
+    the result is always a valid XLA gather index dtype)."""
+    for dt in (np.int16, np.int32, np.int64):
+        if int(max_value) <= np.iinfo(dt).max:
+            return dt
+    raise ValueError(f"index range {max_value} exceeds int64")
+
+
+def compact_value_dtype(max_value: int):
+    """Narrowest signed integer dtype for pure value storage (int8 floor)."""
+    for dt in (np.int8, np.int16, np.int32, np.int64):
+        if int(max_value) <= np.iinfo(dt).max:
+            return dt
+    raise ValueError(f"value range {max_value} exceeds int64")
+
+
+def pack_next_hop(next_hop):
+    """(N, N) int next-hop table -> int16.  Node ids are < N <= 32767
+    (guarded on the static shape); unpack with `unpack_next_hop` — the
+    round trip is exact, pinned by tests/test_layouts.py."""
+    n = next_hop.shape[-1]
+    _guard("next_hop", n - 1, NEXT_HOP_DTYPE)
+    return next_hop.astype(NEXT_HOP_DTYPE)
+
+
+def unpack_next_hop(next_hop):
+    return next_hop.astype(np.int32)

@@ -1,0 +1,61 @@
+"""The layout knob, resolved once at build time (mirror of `precision`).
+
+`cfg.layout` is a string (dense | sparse | auto); every builder resolves it
+through `resolve_layout` into a frozen, hashable `LayoutPolicy` BEFORE any
+tracing happens, and bakes the resolved policy into its jitted closures —
+exactly the `resolve_precision` contract, so flipping the knob costs one
+rebuild, never a mid-steady retrace.
+
+`auto` picks `sparse` on a TPU backend (where the bandwidth wall bites) and
+`dense` elsewhere — same shape as precision's `auto -> bf16 on TPU`.  The
+config DEFAULT stays `dense` until the on-chip gates recorded in
+benchmarks/layout_ab.json pass (see OPERATIONS.md "Layouts").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+LAYOUT_CHOICES = ("dense", "sparse", "auto")
+
+
+@dataclasses.dataclass(frozen=True)
+class LayoutPolicy:
+    """Frozen, hashable layout descriptor — safe to close over in jit."""
+
+    name: str  # "dense" | "sparse" (auto is resolved away)
+
+    @property
+    def sparse(self) -> bool:
+        return self.name == "sparse"
+
+    @property
+    def index_dtype(self):
+        """Dtype for packed integer index vectors (jobs' src, link_index):
+        int16 under the sparse layout (compact-storage satellite; every
+        padded dimension fits 15 bits — guarded in the builders), int32
+        under dense so the parity reference stays byte-identical to r05."""
+        return np.int16 if self.sparse else np.int32
+
+
+DENSE = LayoutPolicy("dense")
+SPARSE = LayoutPolicy("sparse")
+
+
+def resolve_layout(layout=None) -> LayoutPolicy:
+    """str | LayoutPolicy | None -> LayoutPolicy.  None means dense."""
+    if layout is None:
+        return DENSE
+    if isinstance(layout, LayoutPolicy):
+        return layout
+    if layout not in LAYOUT_CHOICES:
+        raise ValueError(
+            f"layout must be one of {LAYOUT_CHOICES}, got '{layout}'"
+        )
+    if layout == "auto":
+        import jax
+
+        return SPARSE if jax.default_backend() == "tpu" else DENSE
+    return SPARSE if layout == "sparse" else DENSE

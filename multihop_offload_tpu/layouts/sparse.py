@@ -1,0 +1,236 @@
+"""Padded edge-list instance representation and its segment-sum kernels.
+
+The sparse layout stores each instance's graph structure as COO edge lists
+padded to a STATIC nnz per shape bucket (`PadSpec.ext_nnz` / `cf_nnz`), so
+every program stays fixed-shape across a bucket — the same pad-to-static
+discipline as node/link/job counts (arXiv:1906.11786).  Padding entries are
+(row=0, col=0, val=0): inert under every segment reduction here.
+
+Three device-side kernel families replace dense (N, N) / (L, L) math:
+
+- `sparse_chebyshev_support` + `make_sparse_propagate`: the ChebConv
+  recurrence as gather + segment-sum with fp32 accumulation (composing with
+  `PrecisionPolicy` — contributions are upcast before the segment-sum, the
+  result narrowed back to the compute dtype);
+- `weight_matrix_from_edges` / `next_hop_from_edges`: APSP stays dense
+  min-plus (genuinely all-pairs), but its input weight matrix is
+  scatter-built from the link list on device, and the greedy next-hop table
+  comes from a directed-edge segment-min instead of an (N, N, N) cost
+  volume.  Both reproduce the dense path BIT-EXACTLY (same gathered values,
+  same lowest-index tie-breaking), which is what makes the dense/sparse
+  decision-agreement-1.0 gate in tests/test_layouts.py possible;
+- the conflict fixed point and the per-route delay reductions consume the
+  conflict edge list / the route step sequence directly (env/queueing.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from multihop_offload_tpu.ops.sparse import COO
+from multihop_offload_tpu.precision import island_dtype
+
+
+@struct.dataclass
+class SparseInstance:
+    """Edge-list twin of the Instance's dense structural matrices.
+
+    Lives as an Optional field ON the Instance (`inst.sparse`): None under
+    the dense layout (an empty pytree subtree — stack/vmap/jit all ignore
+    it), populated by `build_instance(..., layout=sparse)`.  Dense leaves a
+    sparse program never reads are pruned from the compiled executable by
+    jit (`keep_unused=False`), so the bytes win needs no signature changes.
+    """
+
+    ext: COO  # (E, E) extended-line-graph adjacency (ChebConv support input)
+    cf: COO   # (L, L) conflict adjacency (interference fixed point)
+
+
+@struct.dataclass
+class SparseSupport:
+    """Chebyshev support in edge-list form: off-diagonal COO + diagonal."""
+
+    edges: COO
+    diag: jnp.ndarray  # (E,)
+
+    def astype(self, dtype):
+        return SparseSupport(
+            edges=COO(
+                rows=self.edges.rows, cols=self.edges.cols,
+                vals=self.edges.vals.astype(dtype), shape=self.edges.shape,
+            ),
+            diag=self.diag.astype(dtype),
+        )
+
+
+# ---- host-side builders ----------------------------------------------------
+
+
+def _coo_from_dense_np(mat: np.ndarray, nnz_pad: int, val_dtype) -> COO:
+    """Numpy COO extraction with pad-to-static nnz (host-side sibling of
+    `ops.sparse.dense_to_coo` — numpy leaves so `stack_instances` keeps its
+    one-transfer-per-leaf fast path)."""
+    mat = np.asarray(mat)
+    rows, cols = np.nonzero(mat)
+    nnz = int(rows.size)
+    if nnz > nnz_pad:
+        raise ValueError(
+            f"matrix has {nnz} nonzeros > nnz pad {nnz_pad}; raise the "
+            "PadSpec nnz bound (enn/cnn) for this bucket"
+        )
+    r = np.zeros((nnz_pad,), np.int32)
+    c = np.zeros((nnz_pad,), np.int32)
+    v = np.zeros((nnz_pad,), val_dtype)
+    r[:nnz] = rows
+    c[:nnz] = cols
+    v[:nnz] = mat[rows, cols]
+    return COO(rows=r, cols=c, vals=v, shape=tuple(mat.shape))
+
+
+def build_sparse_instance(adj_ext, adj_conflict, ext_nnz: int, cf_nnz: int,
+                          dtype=np.float32) -> SparseInstance:
+    """Extract the edge lists from the already-built padded dense matrices.
+
+    Host numpy, once per instance build — the padded dense matrices exist in
+    both layouts (they stay on the Instance as the parity reference and are
+    DCE'd from sparse programs), so extraction is the cheap part."""
+    return SparseInstance(
+        ext=_coo_from_dense_np(adj_ext, ext_nnz, dtype),
+        cf=_coo_from_dense_np(adj_conflict, cf_nnz, dtype),
+    )
+
+
+def ext_nnz_count(topo, comp_mask: np.ndarray) -> int:
+    """Exact nonzero count of the extended adjacency a topology will build:
+    line-graph entries + both incidence blocks (each endpoint that carries a
+    computing role contributes an (link, node) and (node, link) entry).
+    Used to size per-bucket nnz pads from real data (train.data) and to
+    refuse oversized requests at serve admission."""
+    lg = int(np.count_nonzero(np.asarray(topo.adj_lg)))
+    comp = np.asarray(comp_mask, bool)
+    inc = int(np.count_nonzero(comp[np.asarray(topo.link_ends)]))
+    return lg + 2 * inc
+
+
+def cf_nnz_count(topo) -> int:
+    return int(np.count_nonzero(np.asarray(topo.adj_conflict)))
+
+
+# ---- ChebConv: gather + segment-sum ----------------------------------------
+
+
+def sparse_chebyshev_support(edges: COO, mask=None, lmax: float = 2.0,
+                             dtype=None) -> SparseSupport:
+    """Edge-list twin of `models.chebconv.chebyshev_support`.
+
+    Same fp32-island Laplacian math (degrees, symmetric normalization, the
+    rescale `(2/lmax) * L - I`) computed over the edge list: off-diagonal
+    entries are `-(2/lmax) * a[u,v] / sqrt(deg_u * deg_v)`, the diagonal is
+    `(2/lmax - 1)` on valid nodes.  `lmax=None` (power iteration) is a
+    dense-only feature — raise rather than silently diverge."""
+    if lmax is None:
+        raise ValueError(
+            "sparse layout requires a static lmax (the dense power-iteration "
+            "estimate reads the full matrix); use lmax=2.0"
+        )
+    wide = island_dtype(edges.vals.dtype)  # fp32-island(laplacian)
+    vals = edges.vals.astype(wide)
+    n = edges.shape[0]
+    deg = jax.ops.segment_sum(vals, edges.rows, num_segments=n)
+    valid = deg > 0
+    if mask is not None:
+        valid = valid & mask
+    inv_sqrt = jnp.where(deg > 0, 1.0 / jnp.sqrt(jnp.where(deg > 0, deg, 1.0)), 0.0)
+    scale = 2.0 / lmax
+    evals = -scale * vals * inv_sqrt[edges.rows] * inv_sqrt[edges.cols]
+    diag = (scale - 1.0) * valid.astype(wide)
+    out = dtype or edges.vals.dtype
+    return SparseSupport(
+        edges=COO(rows=edges.rows, cols=edges.cols,
+                  vals=evals.astype(out), shape=edges.shape),
+        diag=diag.astype(out),
+    )
+
+
+def make_sparse_propagate(accum_dtype=None):
+    """Build the ChebConv `propagate` callable for `SparseSupport`.
+
+    `support @ x` as gather + segment-sum: per-edge contributions are upcast
+    to the accumulation dtype (>= fp32 — the fp32-accumulation contract of
+    the sparse layout, independent of the storage dtype) BEFORE the
+    segment-sum, and the result is narrowed back to x's dtype so the
+    Chebyshev recurrence keeps the precision policy's compute dtype."""
+
+    def propagate(support: SparseSupport, x: jnp.ndarray) -> jnp.ndarray:
+        e = support.edges
+        acc = accum_dtype or island_dtype(x.dtype)
+        contrib = (e.vals[:, None] * x[e.cols]).astype(acc)
+        agg = jax.ops.segment_sum(contrib, e.rows, num_segments=x.shape[0])
+        agg = agg + support.diag.astype(acc)[:, None] * x.astype(acc)
+        return agg.astype(x.dtype)
+
+    return propagate
+
+
+def zeros_support(pad, dtype, layout=None) -> object:
+    """Shape-correct all-zero support for param init / warmup (`pad` is a
+    PadSpec, duck-typed to avoid a graphs<->layouts import cycle)."""
+    from multihop_offload_tpu.layouts.policy import resolve_layout
+
+    if not resolve_layout(layout).sparse:
+        return jnp.zeros((pad.e, pad.e), dtype)
+    nnz = pad.ext_nnz
+    return SparseSupport(
+        edges=COO(rows=jnp.zeros((nnz,), jnp.int32),
+                  cols=jnp.zeros((nnz,), jnp.int32),
+                  vals=jnp.zeros((nnz,), dtype), shape=(pad.e, pad.e)),
+        diag=jnp.zeros((pad.e,), dtype),
+    )
+
+
+# ---- decision path: weight matrix + next-hop from the link list ------------
+
+
+def weight_matrix_from_edges(link_ends, link_mask, link_delays,
+                             num_nodes: int) -> jnp.ndarray:
+    """Scatter per-link delays into the (N, N) one-hop weight matrix.
+
+    The dense twin gathers `link_delays[link_index]` through an (N, N) int32
+    map shipped from host; here the same matrix is built on device from the
+    (L, 2) link list — identical VALUES bit for bit (same per-edge delay,
+    +inf elsewhere, pad links write inf at (0, 0) which `.min` keeps inert),
+    so the downstream APSP and every decision are unchanged.  The (N, N)
+    output is the APSP input — genuinely all-pairs by design."""
+    u, v = link_ends[:, 0], link_ends[:, 1]
+    vals = jnp.where(link_mask, link_delays, jnp.inf)
+    w = jnp.full((num_nodes, num_nodes), jnp.inf, link_delays.dtype)
+    w = w.at[u, v].min(vals)
+    w = w.at[v, u].min(vals)
+    return w
+
+
+def next_hop_from_edges(link_ends, link_mask, sp: jnp.ndarray) -> jnp.ndarray:
+    """Greedy next-hop table from the directed link list.
+
+    Dense twin (`env.apsp.next_hop_table`) builds an (N, N, N) masked cost
+    volume and argmins it.  Here each undirected link contributes both
+    directions (derived on device — no extra storage), a segment-min over
+    edge sources finds each row's best cost, and a second segment-min over
+    the cost-tied candidates reproduces the dense argmin's lowest-index
+    tie-breaking exactly.  Rows with no finite option (or no neighbors at
+    all) resolve to 0, as `jnp.argmin` does over an all-inf row."""
+    n = sp.shape[-1]
+    u, v = link_ends[:, 0], link_ends[:, 1]
+    src = jnp.concatenate([u, v])
+    dst = jnp.concatenate([v, u])
+    m = jnp.concatenate([link_mask, link_mask])
+    cost = jnp.where(m[:, None], sp[dst], jnp.inf)                 # (2L, N)
+    best = jax.ops.segment_min(cost, src, num_segments=n)          # (N, N)
+    cand = jnp.where(cost <= best[src], dst[:, None], n)
+    nh = jax.ops.segment_min(cand, src, num_segments=n)            # (N, N)
+    return jnp.where(jnp.isfinite(best) & (nh < n), nh, 0).astype(jnp.int32)

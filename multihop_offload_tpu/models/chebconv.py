@@ -9,9 +9,13 @@ shipped "GNN" degenerates to a per-node MLP that never reads the adjacency
 bit-for-bit; `k>=2` is the spectral GNN the reference intended, with a proper
 rescaled-Laplacian support (`chebyshev_support`).
 
-Dense (E, E) supports are deliberate: extended line graphs top out at a few
-hundred nodes, so the Chebyshev matmuls tile straight onto the MXU — sparse
-gather/segment-sum forms would be slower on TPU at this size.
+The support is pluggable: the dense (E, E) matrix tiles straight onto the
+MXU, while `cfg.layout = sparse` swaps in an edge-list support
+(`layouts.SparseSupport`) and a gather + segment-sum `propagate`
+(`layouts.make_sparse_propagate`) — extended line graphs are BA-sparse
+(nnz ~ 16 E of E^2 entries), so the edge-list form cuts the support's HBM
+traffic by ~E/16 at identical math (fp32 accumulation either way).  Dense
+remains the default and the parity reference (tests/test_layouts.py).
 """
 
 from __future__ import annotations
@@ -250,7 +254,7 @@ def ensure_alive_output_multi(model, variables, probes):
     return best
 
 
-def make_model(cfg: Config, policy=None) -> ChebNet:
+def make_model(cfg: Config, policy=None, layout=None) -> ChebNet:
     """Build the actor stack under the configured precision policy.
 
     `policy` (a `precision.PrecisionPolicy`) defaults to
@@ -258,8 +262,25 @@ def make_model(cfg: Config, policy=None) -> ChebNet:
     pre-policy model exactly (params/compute in `cfg.jnp_dtype`); the bf16
     policy keeps fp32 params, narrows matmul operands to bf16, and
     accumulates in fp32 via `preferred_element_type`.
+
+    `layout` (a `layouts.LayoutPolicy`) defaults to `cfg.layout_policy`:
+    under the sparse layout the model carries the edge-list `propagate`
+    (gather + segment-sum, fp32 accumulation) and expects a
+    `layouts.SparseSupport` wherever the dense path passes an (E, E) matrix.
+    Parameters are layout-independent — the same checkpoint loads either way.
     """
+    from multihop_offload_tpu.layouts import (
+        make_sparse_propagate,
+        resolve_layout,
+    )
+
     pol = policy if policy is not None else cfg.precision_policy
+    lay = resolve_layout(layout if layout is not None else cfg.layout)
+    propagate = None
+    if lay.sparse:
+        propagate = make_sparse_propagate(
+            pol.accum_dtype if pol.mixed else None
+        )
     return ChebNet(
         num_layer=cfg.num_layer,
         hidden=cfg.hidden,
@@ -270,4 +291,5 @@ def make_model(cfg: Config, policy=None) -> ChebNet:
         param_dtype=pol.param_dtype,
         compute_dtype=pol.compute_dtype if pol.mixed else None,
         accum_dtype=pol.accum_dtype if pol.mixed else None,
+        propagate=propagate,
     )

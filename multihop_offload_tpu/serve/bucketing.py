@@ -79,6 +79,7 @@ def pack_bucket(
     slots: int,
     dtype=np.float32,  # fp32-island(storage default; the service passes its policy's storage dtype)
     hop_cache: Optional[Dict] = None,
+    layout=None,
 ) -> Tuple:
     """Pad + stack up to `slots` requests into one batched (Instance, JobSet).
 
@@ -90,6 +91,10 @@ def pack_bucket(
     """
     if not reqs or len(reqs) > slots:
         raise ValueError(f"need 1..{slots} requests, got {len(reqs)}")
+    from multihop_offload_tpu.layouts import resolve_layout
+
+    lay = resolve_layout(layout)
+    index_dtype = np.int32 if not lay.sparse else lay.index_dtype
     insts, jobsets = [], []
     for r in reqs:
         hop = None
@@ -101,11 +106,11 @@ def pack_bucket(
                 hop_cache[(r.topo_key, pad.n)] = hop
         insts.append(build_instance(
             r.topo, r.roles, r.proc_bws, r.link_rates, r.t_max, pad,
-            dtype=dtype, hop=hop, device=False,
+            dtype=dtype, hop=hop, device=False, layout=lay,
         ))
         jobsets.append(build_jobset(
             r.job_src, r.job_rate, pad_jobs=pad.j, ul=r.ul, dl=r.dl,
-            dtype=dtype, device=False,
+            dtype=dtype, device=False, index_dtype=index_dtype,
         ))
     while len(insts) < slots:
         insts.append(insts[-1])

@@ -52,7 +52,9 @@ class BucketExecutor:
         fp_impl: str = "xla",
         prob: bool = False,
         precision=None,
+        layout=None,
     ):
+        from multihop_offload_tpu.layouts import resolve_layout
         from multihop_offload_tpu.ops.fixed_point import resolve_fixed_point
         from multihop_offload_tpu.ops.minplus import resolve_apsp
         from multihop_offload_tpu.precision import resolve_precision
@@ -66,6 +68,11 @@ class BucketExecutor:
         # mixed-precision policy (str | PrecisionPolicy | None): resolved
         # once and baked into the per-bucket closures — no retrace on enable
         self.precision = resolve_precision(precision)
+        # instance layout (str | LayoutPolicy | None): same contract — the
+        # packer builds sparse-leaf instances and the steps close over the
+        # policy, so the knob never appears as a traced value
+        self.layout = resolve_layout(layout)
+        lay = self.layout
         self._steps = {}
         for b, pad in enumerate(buckets.pads):
             apsp_fn, _ = resolve_apsp(apsp_impl, pad.n)
@@ -77,7 +84,7 @@ class BucketExecutor:
                 def one(inst, jb, k):
                     outcome, _ = forward_env(
                         model, variables, inst, jb, k, prob=prob,
-                        apsp_fn=_apsp, fp_fn=_fp,
+                        apsp_fn=_apsp, fp_fn=_fp, layout=lay,
                     )
                     d = outcome.decision
                     return d.dst, d.is_local, d.delay_est, outcome.job_total
@@ -86,7 +93,8 @@ class BucketExecutor:
 
             def baseline_step(binst, bjobs, keys, _apsp=apsp_fn, _fp=fp_fn):
                 def one(inst, jb, k):
-                    o = baseline_policy(inst, jb, k, apsp_fn=_apsp, fp_fn=_fp)
+                    o = baseline_policy(inst, jb, k, apsp_fn=_apsp, fp_fn=_fp,
+                                        layout=lay)
                     d = o.decision
                     return d.dst, d.is_local, d.delay_est, o.job_total
 

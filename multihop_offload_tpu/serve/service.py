@@ -60,9 +60,11 @@ class OffloadService:
         fp_impl: str = "xla",
         dtype=None,
         precision=None,
+        layout=None,
         clock: Callable[[], float] = time.monotonic,
         capture_sample: float = 0.0,
     ):
+        from multihop_offload_tpu.layouts import resolve_layout
         from multihop_offload_tpu.precision import resolve_precision
 
         if slots < 1 or queue_cap < 1:
@@ -70,11 +72,15 @@ class OffloadService:
         # `dtype` is the BASE dtype (cfg.jnp_dtype); `precision` the policy
         # knob (fp32 | bf16 | auto | PrecisionPolicy).  Request packing uses
         # the policy's storage dtype (bf16 halves the per-tick transfer).
+        # `layout` (dense | sparse | auto | LayoutPolicy) is resolved once
+        # the same way; the model must have been built with the same layout
+        # (`models.chebconv.make_model(cfg, layout=...)`).
         self.precision = resolve_precision(precision, dtype)
+        self.layout = resolve_layout(layout)
         self.executor = BucketExecutor(
             model, variables, buckets,
             apsp_impl=apsp_impl, fp_impl=fp_impl, prob=prob,
-            precision=self.precision,
+            precision=self.precision, layout=self.layout,
         )
         self.buckets = buckets
         self.slots = slots
@@ -105,6 +111,8 @@ class OffloadService:
         bucket fits.  Refusal is the client's signal to retry elsewhere —
         a bounded queue keeps the p99 of everything already admitted."""
         b = self.buckets.bucket_for(*req.sizes)
+        if b is not None and self.layout.sparse:
+            b = self._sparse_fit(req, b)
         if b is None:
             self.stats.record_submit("too_large")
             return False
@@ -117,6 +125,24 @@ class OffloadService:
             "mho_serve_queue_depth", "pending admitted requests"
         ).set(self.queue_depth)
         return True
+
+    def _sparse_fit(self, req: OffloadRequest, b: int) -> Optional[int]:
+        """Escalate to the first bucket whose STATIC nnz pads also hold this
+        request's edge lists.  Under the sparse layout an oversized edge
+        count would raise inside `build_instance` mid-tick — admission must
+        refuse it here instead, exactly like an oversized node count."""
+        from multihop_offload_tpu.layouts import cf_nnz_count, ext_nnz_count
+
+        comp_mask = np.asarray(req.roles) < 2
+        enn = ext_nnz_count(req.topo, comp_mask)
+        cnn = cf_nnz_count(req.topo)
+        n, l, s, j = req.sizes
+        for bb in range(b, len(self.buckets)):
+            pad = self.buckets[bb]
+            if (enn <= pad.ext_nnz and cnn <= pad.cf_nnz and n <= pad.n
+                    and l <= pad.l and s <= pad.s and j <= pad.j):
+                return bb
+        return None
 
     # ---- the serving tick --------------------------------------------------
 
@@ -141,7 +167,7 @@ class OffloadService:
                 with span("serve/pack"):
                     binst, bjobs = pack_bucket(
                         reqs, pad, self.slots, dtype=self.dtype,
-                        hop_cache=self._hop_cache,
+                        hop_cache=self._hop_cache, layout=self.layout,
                     )
                 keys = [self.request_key(r.request_id) for r in reqs]
                 while len(keys) < self.slots:   # pad slots reuse the last key

@@ -55,9 +55,13 @@ DEFAULT_UTILS = (0.1, 0.2, 0.3, 0.4, 0.5, 0.7, 0.85)
 
 
 def make_case(seed: int, topo, pad: PadSpec, num_jobs: int,
-              num_servers: int = 2, dtype=np.float32):  # fp32-island(storage default; callers pass the policy dtype)
+              num_servers: int = 2, dtype=np.float32,  # fp32-island(storage default; callers pass the policy dtype)
+              layout=None):
     """One random connected BA case with a mid-load workload (rates are
     rescaled per utilization target afterwards)."""
+    from multihop_offload_tpu.layouts import resolve_layout
+
+    lay = resolve_layout(layout)
     rng = np.random.default_rng(seed)
     n_nodes = topo.n
     deg = np.asarray(topo.adj).sum(axis=1)
@@ -66,11 +70,13 @@ def make_case(seed: int, topo, pad: PadSpec, num_jobs: int,
     roles[servers] = 1
     bws = np.where(roles == 1, 100.0, 8.0)
     rates = sample_link_rates(topo, 50.0, rng=rng)
-    inst = build_instance(topo, roles, bws, rates, 1000.0, pad, dtype=dtype)
+    inst = build_instance(topo, roles, bws, rates, 1000.0, pad, dtype=dtype,
+                          layout=lay)
     mobile = np.setdiff1d(np.arange(n_nodes), servers)
     srcs = rng.choice(mobile, size=min(num_jobs, mobile.size), replace=False)
     jrates = rng.uniform(0.5, 1.0, srcs.size)
-    jobs = build_jobset(srcs, jrates, pad_jobs=pad.j, dtype=dtype)
+    jobs = build_jobset(srcs, jrates, pad_jobs=pad.j, dtype=dtype,
+                        index_dtype=lay.index_dtype)
     return inst, jobs
 
 

@@ -30,6 +30,13 @@ from multihop_offload_tpu.env.apsp import (
 from multihop_offload_tpu.env.baseline import baseline_unit_delays
 from multihop_offload_tpu.env.offloading import offload_decide
 from multihop_offload_tpu.graphs.instance import Instance, JobSet
+from multihop_offload_tpu.layouts import (
+    NEXT_HOP_DTYPE,
+    next_hop_from_edges,
+    pack_next_hop,
+    resolve_layout,
+    weight_matrix_from_edges,
+)
 from multihop_offload_tpu.sim.state import SimRoutes
 
 POLICY_KINDS = ("gnn", "baseline", "local")
@@ -46,14 +53,25 @@ def decide_routes(
     explore=0.0,
     prob: bool = False,
     apsp_fn=None,
+    layout=None,
 ) -> SimRoutes:
     """Shared decision skeleton on arbitrary unit delays (the sim-side twin
     of `evaluate_spmatrix_policy`, returning the forwarding table instead
-    of analytic scores)."""
+    of analytic scores).  The forwarding table ships compact (int16,
+    `layouts.pack_next_hop`) under EVERY layout — node ids are tiny and the
+    (N, N) table rides the scan carry through the whole run."""
     inf = jnp.inf
+    lay = resolve_layout(layout)
     link_delays = jnp.where(link_up, link_delays, inf)
     unit_diag = jnp.where(node_up, unit_diag, inf)
-    w = weight_matrix_from_link_delays(inst.adj, inst.link_index, link_delays)
+    if lay.sparse:
+        w = weight_matrix_from_edges(
+            inst.link_ends, inst.link_mask, link_delays, inst.num_pad_nodes
+        )
+    else:
+        w = weight_matrix_from_link_delays(
+            inst.adj, inst.link_index, link_delays
+        )
     sp = (apsp_fn or apsp_minplus)(w)
     dec = offload_decide(
         inst, jobs_est, sp, inst.hop, unit_diag, key, explore, prob
@@ -63,10 +81,12 @@ def decide_routes(
     reachable = jnp.isfinite(
         sp[jobs_est.src, dec.dst]
     ) & node_up[dec.dst]
-    dst = jnp.where(reachable, dec.dst, jobs_est.src)
+    dst = jnp.where(reachable, dec.dst, jobs_est.src.astype(jnp.int32))
+    nh = (next_hop_from_edges(inst.link_ends, inst.link_mask, sp)
+          if lay.sparse else next_hop_table(inst.adj, sp))
     return SimRoutes(
         dst=dst.astype(jnp.int32),
-        next_hop=next_hop_table(inst.adj, sp),
+        next_hop=pack_next_hop(nh),
         reach=jnp.isfinite(sp),
     )
 
@@ -81,6 +101,7 @@ def make_policy(
     apsp_fn=None,
     fp_fn=None,
     precision=None,
+    layout=None,
 ):
     """Build the per-round policy function for `sim.runner.simulate`.
 
@@ -88,12 +109,15 @@ def make_policy(
     inside the decision skeleton under the bf16 policy — resolved here at
     build time and closed over, so the compiled sim program never retraces.
     The decision read-back stays an fp32 island (`env.offloading`).
+    `layout` follows the same contract: resolved once, closed over, and the
+    instances fed to the returned function must have been built with it.
     """
     from multihop_offload_tpu.precision import resolve_precision
 
     if kind not in POLICY_KINDS:
         raise ValueError(f"unknown sim policy '{kind}'; one of {POLICY_KINDS}")
     apsp_fn = resolve_precision(precision).wrap_apsp(apsp_fn)
+    lay = resolve_layout(layout)
 
     if kind == "local":
 
@@ -101,8 +125,8 @@ def make_policy(
             n = inst.num_pad_nodes
             return SimRoutes(
                 dst=jobs_est.src.astype(jnp.int32),
-                next_hop=jnp.zeros((n, n), jnp.int32),   # never consulted
-                reach=jnp.zeros((n, n), bool),
+                next_hop=jnp.zeros((n, n), NEXT_HOP_DTYPE),   # dense-ok(never consulted; scan-carry shape must match the deciding policies)
+                reach=jnp.zeros((n, n), bool),                # dense-ok(same carry-shape constraint)
             )
 
         return local_fn
@@ -113,7 +137,7 @@ def make_policy(
             link_d, node_d = baseline_unit_delays(inst)
             return decide_routes(
                 inst, jobs_est, link_d, node_d, node_up, link_up, key,
-                explore=explore, prob=prob, apsp_fn=apsp_fn,
+                explore=explore, prob=prob, apsp_fn=apsp_fn, layout=lay,
             )
 
         return baseline_fn
@@ -127,15 +151,19 @@ def make_policy(
             default_support,
         )
 
-        sup = default_support(model, inst) if support is None else support
+        sup = (default_support(model, inst, layout=lay)
+               if support is None else support)
         actor = actor_delay_matrix(
-            model, variables, inst, jobs_est, sup, fp_fn=fp_fn
+            model, variables, inst, jobs_est, sup, fp_fn=fp_fn, layout=lay
         )
+        if lay.sparse:
+            unit_diag = jnp.where(inst.comp_mask, actor.node_delay, jnp.inf)
+        else:
+            unit_diag = jnp.diagonal(actor.delay_matrix)
         return decide_routes(
-            inst, jobs_est, actor.link_delay,
-            jnp.diagonal(actor.delay_matrix),
+            inst, jobs_est, actor.link_delay, unit_diag,
             node_up, link_up, key,
-            explore=explore, prob=prob, apsp_fn=apsp_fn,
+            explore=explore, prob=prob, apsp_fn=apsp_fn, layout=lay,
         )
 
     return gnn_fn

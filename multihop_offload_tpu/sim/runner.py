@@ -99,10 +99,14 @@ def simulate(
         )
         return (st2, st.generated, routes), (est, scheds)
 
+    from multihop_offload_tpu.layouts import NEXT_HOP_DTYPE
+
     routes0 = SimRoutes(
         dst=jnp.zeros((j,), jnp.int32),
-        next_hop=jnp.zeros((n, n), jnp.int32),
-        reach=jnp.zeros((n, n), bool),
+        # compact int16 table — dtype must match what policy_fn emits
+        # (layouts.pack_next_hop) or the round-scan carry mismatches
+        next_hop=jnp.zeros((n, n), NEXT_HOP_DTYPE),  # dense-ok(scan-carry seed for the policy's forwarding table)
+        reach=jnp.zeros((n, n), bool),               # dense-ok(scan-carry seed, same constraint)
     )
     xs = (
         jax.random.split(key, rounds),

@@ -74,7 +74,8 @@ class SimRoutes:
     """The policy's routing decision, fixed between policy rounds."""
 
     dst: jnp.ndarray        # (J,) int32 compute destination per job
-    next_hop: jnp.ndarray   # (N, N) int32 greedy forwarding table
+    next_hop: jnp.ndarray   # (N, N) int16 greedy forwarding table
+    #                         (layouts.pack_next_hop — node ids are < N)
     reach: jnp.ndarray      # (N, N) bool: destination reachable from node
 
 
@@ -83,7 +84,9 @@ class SimState:
     """All mutable simulator state for one instance."""
 
     # ring buffers, (Q + 1, cap): row Q is the masked-write scratch row
-    buf_stream: jnp.ndarray   # int32 stream id of each stored packet
+    buf_stream: jnp.ndarray   # int16 stream id of each stored packet (ids are
+    #                           < 2J; used as scatter indices -> int16 floor,
+    #                           layouts.compact_index_dtype)
     buf_birth: jnp.ndarray    # int32 slot the packet entered the network
     buf_enq: jnp.ndarray      # int32 slot the packet entered THIS queue
     head: jnp.ndarray         # (Q + 1,) int32 ring head index
@@ -103,12 +106,17 @@ class SimState:
 
 
 def init_state(spec: SimSpec, dtype=jnp.float32) -> SimState:  # fp32-island(delay accumulators: bf16 drops +1 past 256)
+    from multihop_offload_tpu.layouts import compact_index_dtype
+
     q1 = spec.num_queues + 1
     c = spec.cap
     s = spec.num_streams
     i32 = jnp.int32
+    # stream ids fit the narrowest index dtype for [0, 2J) — int16 in
+    # practice; the bound is static so the choice can never overflow
+    sdt = compact_index_dtype(max(spec.num_streams - 1, 0))
     return SimState(
-        buf_stream=jnp.zeros((q1, c), i32),
+        buf_stream=jnp.zeros((q1, c), sdt),
         buf_birth=jnp.zeros((q1, c), i32),
         buf_enq=jnp.zeros((q1, c), i32),
         head=jnp.zeros((q1,), i32),

@@ -192,7 +192,11 @@ def sim_slot_step(
     m = num_links + 2 * j
     tgt = jnp.concatenate([tgt_q, tgt_a])                     # (M,)
     put = jnp.concatenate([put_l, put_a])
-    strm = jnp.concatenate([s_l, jnp.arange(2 * j, dtype=i32)])
+    # stream ids keep the ring buffer's compact dtype (int16) end to end —
+    # a wider arange here would promote the concat and fail the .set below
+    strm = jnp.concatenate(
+        [s_l, jnp.arange(2 * j, dtype=state.buf_stream.dtype)]
+    )
     births = jnp.concatenate([birth_l, jnp.full((2 * j,), t, i32)])
     onehot = (put[:, None] & (tgt[:, None] == jnp.arange(q, dtype=i32)[None, :]))
     rank = jnp.cumsum(onehot.astype(i32), axis=0)[jnp.arange(m, dtype=i32), tgt] - 1

@@ -35,9 +35,25 @@ def _pad_for(records: List[CaseRecord], cfg: Config) -> PadSpec:
          for r in records],
         round_to=cfg.round_to,
     )
+    enn = cnn = 0
+    if cfg.layout_policy.sparse:
+        # exact per-bucket nnz bounds from the data (rounded up so nearby
+        # buckets can share a compiled shape) instead of the generous
+        # heuristic defaults — the whole bandwidth win of the edge-list
+        # layout is in not padding to a worst case the data never reaches
+        from multihop_offload_tpu.layouts import cf_nnz_count, ext_nnz_count
+
+        enn = PadSpec.round_up(
+            max(ext_nnz_count(r.topo, np.asarray(r.roles) < 2)
+                for r in records), 128,
+        )
+        cnn = PadSpec.round_up(
+            max(cf_nnz_count(r.topo) for r in records), 128
+        )
     return PadSpec(
         n=cfg.pad_nodes or base.n, l=cfg.pad_links or base.l,
         s=cfg.pad_servers or base.s, j=cfg.pad_jobs or base.j,
+        enn=enn, cnn=cnn,
     )
 
 
@@ -83,6 +99,7 @@ class DatasetCache:
         global_pad = PadSpec(
             n=max(p.n for p in pads), l=max(p.l for p in pads),
             s=max(p.s for p in pads), j=max(p.j for p in pads),
+            enn=max(p.enn for p in pads), cnn=max(p.cnn for p in pads),
         )
         return cls(cfg=cfg, records=records, pad=global_pad, pads=pads,
                    bucket_of=bucket_of)
@@ -116,7 +133,7 @@ class DatasetCache:
             rec.topo, rec.roles, rec.proc_bws, rates,
             float(self.cfg.T), pad,
             dtype=self.cfg.precision_policy.storage_dtype, hop=hop,
-            device=False,
+            device=False, layout=self.cfg.layout_policy,
         )
 
 
@@ -129,6 +146,7 @@ def sample_jobsets(
     ul: float = 100.0,
     dl: float = 1.0,
     dtype=None,
+    index_dtype=np.int32,
 ) -> tuple:
     """`num_instances` independent workloads on one network, stacked for vmap.
 
@@ -136,7 +154,8 @@ def sample_jobsets(
     of mobile nodes, arrival rates U(0.1, 0.5) * arrival_scale.
 
     `dtype` is the STORAGE dtype of the jobset arrays — pass the precision
-    policy's `storage_dtype` (the drivers do); None defaults to float32.
+    policy's `storage_dtype` (the drivers do); `index_dtype` the source-node
+    storage width (`LayoutPolicy.index_dtype`, int16 under sparse).
     """
     dtype = np.float32 if dtype is None else dtype
     sets: List[JobSet] = []
@@ -148,7 +167,7 @@ def sample_jobsets(
         rates = arrival_scale * rng.uniform(0.1, 0.5, nj)
         sets.append(
             build_jobset(mobile[:nj], rates, pad_jobs=pad.j, ul=ul, dl=dl,
-                         dtype=dtype, device=False)
+                         dtype=dtype, device=False, index_dtype=index_dtype)
         )
         counts.append(nj)
     return stack_instances(sets), np.asarray(counts)

@@ -102,10 +102,16 @@ class _Harness:
         # jitted closures below (like apsp_impl/fp_impl) — never traced,
         # so enabling bf16 causes zero retraces after steady
         self.precision = cfg.precision_policy
-        self.model = make_model(cfg, policy=self.precision)
+        # instance layout, resolved once alongside precision and closed into
+        # the same jitted programs — flipping it swaps compiled executables,
+        # never retraces a running one
+        self.layout = cfg.layout_policy
+        self.model = make_model(cfg, policy=self.precision, layout=self.layout)
         pad = self.data.pad
         feats0 = jnp.zeros((pad.e, 4), cfg.jnp_dtype)
-        support0 = jnp.zeros((pad.e, pad.e), cfg.jnp_dtype)
+        from multihop_offload_tpu.layouts import zeros_support
+
+        support0 = zeros_support(pad, cfg.jnp_dtype, self.layout)
         self.model_dir = cfg.model_dir()
         self.variables, loaded = _init_params(
             cfg, self.model, (feats0, support0), self.model_dir
@@ -129,10 +135,22 @@ class _Harness:
                     self.data.records[fid], self.data.pad_of(fid), 1,
                     probe_rng, cfg.arrival_scale, ul=cfg.ul_data,
                     dl=cfg.dl_data, dtype=self.precision.storage_dtype,
+                    index_dtype=self.layout.index_dtype,
                 )
                 jb_p = jax.tree_util.tree_map(lambda x: x[0], js_p)
+                if self.layout.sparse:
+                    # edge-list twin of the raw-adjacency probe support
+                    from multihop_offload_tpu.layouts import SparseSupport
+
+                    sup_p = SparseSupport(
+                        edges=inst_p.sparse.ext,
+                        diag=jnp.zeros((inst_p.ext_mask.shape[0],),
+                                       cfg.jnp_dtype),
+                    )
+                else:
+                    sup_p = inst_p.adj_ext
                 probes.append((build_ext_features(inst_p, jb_p),
-                               inst_p.adj_ext, inst_p.ext_mask))
+                               sup_p, inst_p.ext_mask))
             self.variables = ensure_alive_output_multi(
                 self.model, self.variables, probes
             )
@@ -201,6 +219,7 @@ class _Harness:
         from multihop_offload_tpu.ops.fixed_point import resolve_fixed_point
 
         fp_fn, self.fp_path = resolve_fixed_point(self.cfg.fp_impl, self.data.pad.l)
+        lay = self.layout
 
         def gnn_train_step(variables, mem, inst, jobsets, keys, explore):
             """vmapped forward_backward + in-program gradient memorization."""
@@ -214,6 +233,7 @@ class _Harness:
                                         critic_weight=critic_w,
                                         mse_weight=mse_w,
                                         apsp_fn=apsp_fn, fp_fn=fp_fn,
+                                        layout=lay,
                                         compat_diagonal_bug=compat_diag)
 
             outs = jax.vmap(one, in_axes=(0, 0))(jobsets, keys)
@@ -233,16 +253,18 @@ class _Harness:
             and sharded variant below wraps this same closure."""
             bl = jax.vmap(
                 lambda jb, k: baseline_policy(
-                    inst, jb, k, apsp_fn=apsp_fn, fp_fn=fp_fn
+                    inst, jb, k, apsp_fn=apsp_fn, fp_fn=fp_fn, layout=lay
                 ).job_total
             )(jobsets, keys)
             loc = jax.vmap(
-                lambda jb: local_policy(inst, jb, fp_fn=fp_fn).job_total
+                lambda jb: local_policy(
+                    inst, jb, fp_fn=fp_fn, layout=lay
+                ).job_total
             )(jobsets)
             gnn = jax.vmap(
                 lambda jb, k: forward_env(
                     model, variables, inst, jb, k, prob=prob, apsp_fn=apsp_fn,
-                    fp_fn=fp_fn, compat_diagonal_bug=compat_diag,
+                    fp_fn=fp_fn, layout=lay, compat_diagonal_bug=compat_diag,
                 )[0].job_total
             )(jobsets, keys)
             return bl, loc, gnn
@@ -274,7 +296,8 @@ class _Harness:
         self._gnn_train_step_dp = make_file_dp_train_step(
             model, mesh, dropout=use_dropout, prob=prob,
             critic_weight=critic_w, mse_weight=mse_w, apsp_fn=apsp_fn,
-            fp_fn=fp_fn, compat_diagonal_bug=compat_diag,
+            fp_fn=fp_fn, layout=self.layout,
+            compat_diagonal_bug=compat_diag,
         )
         self._eval_methods_dp = make_sharded_eval_step(eval_methods, mesh)
         self._eval_files_dp = make_files_eval_step(eval_methods, mesh)
@@ -583,6 +606,7 @@ class Trainer(_Harness):
                     rec, self.data.pad_of(fid), cfg.num_instances, self.rng,
                     cfg.arrival_scale, ul=cfg.ul_data, dl=cfg.dl_data,
                     dtype=self.precision.storage_dtype,
+                    index_dtype=self.layout.index_dtype,
                 )
             return (rec, inst, jobsets, counts), time.time() - t0
 
@@ -758,6 +782,7 @@ class Evaluator(_Harness):
                 rec, self.data.pad_of(fid), cfg.num_instances, frng,
                 cfg.arrival_scale, ul=cfg.ul_data, dl=cfg.dl_data,
                 dtype=self.precision.storage_dtype,
+                index_dtype=self.layout.index_dtype,
             )
         return (rec, inst, jobsets, counts), time.time() - t0
 

@@ -19,6 +19,15 @@ A deliberate fp32 island is waived per line with an explicit reason:
 
 `precision.py` itself (the policy definition) is exempt.
 
+`--layout` runs the sparse-layout rule (SL001, same shape as MP001):
+hot-path modules (env/ models/ serve/ sim/) must not materialize new dense
+square (N, N)-style arrays — instance structure flows through the padded
+edge lists in `layouts/` (ISSUE 7 / BENCH_r05: dense materializations are
+what pinned arithmetic intensity at 0.117).  A deliberate dense buffer
+(parity reference, train target, scan-carry shape) is waived per line:
+
+    unit_matrix = jnp.zeros((n, n), dt)  # dense-ok(train target)
+
 Zero third-party imports, stdlib-only, so the gate runs anywhere the repo
 does.  Exit status: 0 clean, 1 findings, 2 usage error.
 """
@@ -33,6 +42,14 @@ import sys
 PRECISION_HOT_DIRS = ("env", "models", "agent", "serve", "sim")
 _F32_LITERAL = re.compile(r"\b(?:jnp|np|numpy)\.float32\b")
 _WAIVER = "# fp32-island("
+
+LAYOUT_HOT_DIRS = ("env", "models", "serve", "sim")
+# square dense constructor: both dims the same symbol, e.g. zeros((n, n))
+_SQUARE_DENSE = re.compile(
+    r"\b(?:jnp|np|numpy)\.(?:zeros|ones|full|empty)\(\s*"
+    r"\(\s*([A-Za-z_][\w.]*)\s*,\s*\1\s*[,)]"
+)
+_LAYOUT_WAIVER = "# dense-ok("
 
 
 def _py_files(roots):
@@ -156,8 +173,33 @@ def check_precision_file(path: str):
     return findings
 
 
+def check_layout_file(path: str):
+    """SL001: new dense square (N, N)-style materialization in a hot-path
+    module (see module docstring).  Waive a deliberate dense buffer with
+    `# dense-ok(<why>)`."""
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    findings = []
+    for lineno, line in enumerate(src.splitlines(), 1):
+        code = line.split("#", 1)[0]
+        if not _SQUARE_DENSE.search(code):
+            continue
+        if _LAYOUT_WAIVER in line or "# noqa" in line:
+            continue
+        findings.append((lineno, (
+            "SL001 dense square materialization in hot path — route through "
+            "the padded edge lists in layouts/, or waive with "
+            "'# dense-ok(<why>)'"
+        )))
+    return findings
+
+
 def precision_roots(pkg="multihop_offload_tpu"):
     return [os.path.join(pkg, d) for d in PRECISION_HOT_DIRS]
+
+
+def layout_roots(pkg="multihop_offload_tpu"):
+    return [os.path.join(pkg, d) for d in LAYOUT_HOT_DIRS]
 
 
 def main(argv):
@@ -165,6 +207,9 @@ def main(argv):
     if argv and argv[0] == "--precision":
         check = check_precision_file
         argv = argv[1:] or precision_roots()
+    elif argv and argv[0] == "--layout":
+        check = check_layout_file
+        argv = argv[1:] or layout_roots()
     roots = argv or ["multihop_offload_tpu"]
     total = 0
     for path in sorted(_py_files(roots)):

@@ -8,8 +8,10 @@
 # to scripts/_lint_fallback.py, an AST checker approximating the same rule
 # classes (syntax errors, unused imports, undefined-name smells).  The
 # mixed-precision rule (MP001: no hardcoded float32 in hot-path modules —
-# waive fp32 islands with `# fp32-island(<why>)`) has no ruff equivalent
-# and runs on BOTH branches.  Exit 0 = clean.
+# waive fp32 islands with `# fp32-island(<why>)`) and the sparse-layout
+# rule (SL001: no new dense (N, N) materializations in hot-path modules —
+# waive with `# dense-ok(<why>)`) have no ruff equivalent and run on BOTH
+# branches.  Exit 0 = clean.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -24,4 +26,9 @@ else
 fi
 
 # repo-specific: hot paths must take dtypes from precision.PrecisionPolicy
-exec python scripts/_lint_fallback.py --precision
+python scripts/_lint_fallback.py --precision
+
+# repo-specific: no new dense square (N, N) materializations in hot paths —
+# instance structure flows through layouts/ edge lists; waive deliberate
+# dense buffers with `# dense-ok(<why>)` (SL001)
+exec python scripts/_lint_fallback.py --layout

@@ -5,10 +5,13 @@
 #
 # Chains (each must pass; total budget well under 90s on a CPU host):
 #   1. bash scripts/lint.sh          — ruff (or the stdlib AST fallback)
-#      plus the repo's MP001 mixed-precision rule;
+#      plus the repo's MP001 mixed-precision and SL001 layout rules;
 #   2. mho-sim --smoke               — tiny simulator fleet: exact packet
 #      conservation + a link-failure round;
-#   3. mho-loop --smoke              — the continual-learning flywheel end
+#   3. mho-sim --smoke --layout sparse — the same fleet on the padded-COO
+#      sparse instance layout (edge-list propagate, gathered delay math,
+#      int16 indices) — proves the layout knob end to end;
+#   4. mho-loop --smoke              — the continual-learning flywheel end
 #      to end: capture -> refit -> sim-gated A/B -> promote through
 #      hot-reload (zero unexpected retraces) -> injected regression ->
 #      automatic rollback; writes benchmarks/loop_smoke.json.
@@ -20,13 +23,16 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
-echo "== [1/3] lint =="
+echo "== [1/4] lint =="
 bash scripts/lint.sh
 
-echo "== [2/3] mho-sim --smoke =="
+echo "== [2/4] mho-sim --smoke =="
 python -m multihop_offload_tpu.cli.sim --smoke
 
-echo "== [3/3] mho-loop --smoke =="
+echo "== [3/4] mho-sim --smoke --layout sparse =="
+python -m multihop_offload_tpu.cli.sim --smoke --layout sparse
+
+echo "== [4/4] mho-loop --smoke =="
 python -m multihop_offload_tpu.cli.loop --smoke
 
 echo "smoke: all green"

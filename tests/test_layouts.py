@@ -1,0 +1,281 @@
+"""Sparse instance layouts: decision parity, padding inertness, compact ints.
+
+Tier-1 (CPU) gate for the `cfg.layout` knob (ISSUE 7): the sparse layout's
+decision path — scatter-built weight matrix, k-blocked min-plus APSP,
+segment-min next hop — is BIT-IDENTICAL to the dense parity reference, so
+offload-decision agreement is pinned at exactly 1.0 (not a floor), per-method
+job totals agree to summation-order noise, the pad-to-static nnz bound is
+inert, and the compact int16 storage round-trips exactly.  The committed
+gate (`benchmarks/layout_ab.json`, scripts/layout_ab.py) uses the same
+thresholds over more seeds.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from multihop_offload_tpu.env.apsp import (
+    apsp_minplus,
+    apsp_minplus_blocked,
+    next_hop_table,
+    weight_matrix_from_link_delays,
+)
+from multihop_offload_tpu.env.policies import baseline_policy, local_policy
+from multihop_offload_tpu.env.routing import trace_routes
+from multihop_offload_tpu.graphs import generators
+from multihop_offload_tpu.graphs.instance import PadSpec, build_jobset
+from multihop_offload_tpu.graphs.topology import build_topology
+from multihop_offload_tpu.layouts import (
+    LayoutPolicy,
+    make_sparse_propagate,
+    next_hop_from_edges,
+    pack_next_hop,
+    resolve_layout,
+    sparse_chebyshev_support,
+    unpack_next_hop,
+    weight_matrix_from_edges,
+)
+from multihop_offload_tpu.layouts.sparse import _coo_from_dense_np
+from multihop_offload_tpu.models.chebconv import chebyshev_support
+from multihop_offload_tpu.sim.fidelity import make_case
+
+TAU_RTOL = 1e-4   # dense vs sparse mean job totals (summation-order noise
+#                   in the gathered delay reductions; same fp32 ops)
+
+
+def _case(seed, layout, dtype=np.float32, n_nodes=16, num_jobs=8):
+    topo = build_topology(generators.barabasi_albert(n_nodes, seed=seed)[0])
+    pad = PadSpec(n=16, l=-(-topo.num_links // 8) * 8, s=8, j=num_jobs)
+    return make_case(seed, topo, pad, num_jobs, dtype=dtype, layout=layout)
+
+
+# ---- policy resolution -----------------------------------------------------
+
+
+def test_resolve_identity_dense():
+    lay = resolve_layout("dense")
+    assert not lay.sparse
+    assert np.dtype(lay.index_dtype) == np.dtype(np.int32)
+    # None means dense (the default until the layout_ab on-chip gates pass)
+    assert not resolve_layout(None).sparse
+    # resolving an already-resolved policy is idempotent
+    assert resolve_layout(lay) is lay
+
+
+def test_resolve_sparse_and_auto():
+    lay = resolve_layout("sparse")
+    assert lay.sparse
+    # compact-storage satellite: sparse packs index vectors to int16
+    assert np.dtype(lay.index_dtype) == np.dtype(np.int16)
+    with pytest.raises(ValueError):
+        resolve_layout("banana")
+    # auto resolves by backend: sparse only on TPU (tier-1 runs on CPU)
+    auto = resolve_layout("auto")
+    assert auto.sparse == (jax.default_backend() == "tpu")
+
+
+def test_policy_is_hashable_and_closable():
+    # the build-time contract: the resolved policy is baked into jitted
+    # closures, so it must hash and compare by value
+    assert resolve_layout("sparse") == LayoutPolicy("sparse")
+    assert hash(resolve_layout("dense")) == hash(LayoutPolicy("dense"))
+
+
+# ---- decision-path builders: bit parity with the dense twins ---------------
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_weight_matrix_and_next_hop_bit_parity(seed):
+    inst, _ = _case(seed, "sparse")
+    rng = np.random.default_rng(seed)
+    ld = jnp.asarray(
+        rng.uniform(0.05, 2.0, inst.num_pad_links).astype(np.float32)
+    )
+    wd = weight_matrix_from_link_delays(inst.adj, inst.link_index, ld)
+    ws = weight_matrix_from_edges(
+        inst.link_ends, inst.link_mask, ld, inst.num_pad_nodes
+    )
+    both_inf = jnp.isinf(wd) & jnp.isinf(ws)
+    assert bool(jnp.all((wd == ws) | both_inf))
+
+    sp = apsp_minplus(wd)
+    nhd = next_hop_table(inst.adj, sp)
+    nhs = next_hop_from_edges(inst.link_ends, inst.link_mask, sp)
+    assert bool(jnp.all(nhd == nhs))
+
+
+@pytest.mark.parametrize("n", [13, 16, 24])
+def test_apsp_blocked_bit_identical(n):
+    # fp min is exact under any reduction order and the candidate sums are
+    # the same ops, so blocking changes NOTHING — including non-divisible N
+    # (the k axis pads with +inf, inert for nonnegative weights)
+    rng = np.random.default_rng(n)
+    adj = rng.random((n, n)) < 0.2
+    adj = np.triu(adj, 1)
+    adj = adj | adj.T
+    w = np.where(adj, rng.random((n, n)) + 0.1, np.inf).astype(np.float32)
+    w = np.minimum(w, w.T)
+    a = apsp_minplus(jnp.asarray(w))
+    b = apsp_minplus_blocked(jnp.asarray(w), block=8)
+    assert bool(jnp.all((a == b) | (jnp.isinf(a) & jnp.isinf(b))))
+
+
+# ---- offload decisions: agreement pinned at exactly 1.0 --------------------
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_decision_agreement_exact(seed):
+    key = jax.random.PRNGKey(seed)
+    outs = {}
+    for name in ("dense", "sparse"):
+        inst, jobs = _case(seed, name)
+        outs[name] = (
+            baseline_policy(inst, jobs, key, layout=name),
+            local_policy(inst, jobs, layout=name),
+            jobs,
+        )
+    bd, ld_, jobs = outs["dense"]
+    bs, ls, _ = outs["sparse"]
+    m = np.asarray(jobs.mask)
+    # the acceptance gate: dense and sparse must take the SAME decisions
+    assert (np.asarray(bd.decision.dst)[m] == np.asarray(bs.decision.dst)[m]).all()
+    for dout, sout in ((bd, bs), (ld_, ls)):
+        td = float(np.asarray(dout.job_total, np.float64)[m].mean())
+        ts = float(np.asarray(sout.job_total, np.float64)[m].mean())
+        assert abs(ts - td) / td <= TAU_RTOL
+
+
+def test_forward_backward_parity():
+    # the tentpole train path: step-form critic + gathered reductions under
+    # the sparse layout vs the dense incidence reference
+    from multihop_offload_tpu.agent.actor import (
+        build_ext_features,
+        default_support,
+    )
+    from multihop_offload_tpu.agent.train_step import forward_backward
+    from multihop_offload_tpu.config import Config
+    from multihop_offload_tpu.models.chebconv import make_model
+
+    cfg = Config()
+    key = jax.random.PRNGKey(0)
+    outs = {}
+    for name in ("dense", "sparse"):
+        inst, jobs = _case(5, name)
+        model = make_model(cfg, layout=name)
+        sup = default_support(model, inst, layout=name)
+        vs = model.init(
+            jax.random.PRNGKey(7), build_ext_features(inst, jobs), sup
+        )
+        outs[name] = forward_backward(
+            model, vs, inst, jobs, key, support=sup, layout=name
+        )
+    d, s = outs["dense"], outs["sparse"]
+    assert bool(jnp.all(d.dst == s.dst))
+    assert jnp.allclose(d.loss_critic, s.loss_critic, rtol=1e-5)
+    assert jnp.allclose(d.loss_mse, s.loss_mse, rtol=1e-5)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(d.grads), jax.tree_util.tree_leaves(s.grads)
+    ):
+        assert jnp.allclose(a, b, rtol=1e-4, atol=1e-6)
+
+
+# ---- E_max padding: the nnz bound is inert ---------------------------------
+
+
+def test_nnz_padding_inert():
+    inst, _ = _case(0, "sparse")
+    adj_ext = np.asarray(inst.adj_ext)
+    nnz = int(np.count_nonzero(adj_ext))
+    pad_a = -(-nnz // 128) * 128
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(
+        rng.standard_normal((adj_ext.shape[0], 4)).astype(np.float32)
+    )
+    prop = make_sparse_propagate()
+    outs = []
+    for nnz_pad in (pad_a, pad_a + 128):
+        coo = _coo_from_dense_np(adj_ext, nnz_pad, np.float32)
+        sup = sparse_chebyshev_support(coo, mask=inst.ext_mask)
+        outs.append(prop(sup, x))
+    # padded entries carry value 0 at slot (0, 0): they add exact zeros to
+    # one segment, so a bigger bound changes no bit of the output
+    assert bool(jnp.all(outs[0] == outs[1]))
+
+
+def test_nnz_overflow_raises():
+    inst, _ = _case(0, "sparse")
+    adj_ext = np.asarray(inst.adj_ext)
+    nnz = int(np.count_nonzero(adj_ext))
+    with pytest.raises(ValueError, match="nnz pad"):
+        _coo_from_dense_np(adj_ext, nnz - 1, np.float32)
+
+
+def test_sparse_support_matches_dense():
+    inst, _ = _case(1, "sparse")
+    dense_sup = chebyshev_support(inst.adj_ext, mask=inst.ext_mask)
+    sup = sparse_chebyshev_support(inst.sparse.ext, mask=inst.ext_mask)
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(
+        rng.standard_normal((inst.adj_ext.shape[0], 3)).astype(np.float32)
+    )
+    dense_out = dense_sup @ x
+    sparse_out = make_sparse_propagate()(sup, x)
+    assert jnp.allclose(dense_out, sparse_out, rtol=1e-5, atol=1e-6)
+
+
+# ---- compact integer storage -----------------------------------------------
+
+
+def test_int16_next_hop_round_trip():
+    rng = np.random.default_rng(3)
+    nh = jnp.asarray(rng.integers(0, 300, (300, 300)).astype(np.int32))
+    packed = pack_next_hop(nh)
+    assert packed.dtype == jnp.int16
+    back = unpack_next_hop(packed)
+    assert back.dtype == jnp.int32
+    assert bool(jnp.all(back == nh))
+
+
+def test_int16_jobs_trace_identically():
+    inst, _ = _case(2, "sparse")
+    rng = np.random.default_rng(2)
+    srcs = rng.choice(np.arange(4, 14), size=6, replace=False)
+    rates = rng.uniform(0.5, 1.0, 6)
+    routes = {}
+    for idt in (np.int32, np.int16):
+        jobs = build_jobset(srcs, rates, pad_jobs=8, index_dtype=idt)
+        assert np.dtype(jobs.src.dtype) == np.dtype(idt)
+        w = weight_matrix_from_edges(
+            inst.link_ends, inst.link_mask,
+            jnp.ones((inst.num_pad_links,), jnp.float32), inst.num_pad_nodes,
+        )
+        nh = next_hop_from_edges(
+            inst.link_ends, inst.link_mask, apsp_minplus_blocked(w)
+        )
+        dst = jnp.zeros((jobs.src.shape[0],), jnp.int32)  # all offload to 0
+        routes[idt] = trace_routes(inst, nh, jobs, dst)
+    assert bool(jnp.all(routes[np.int32].seq_slot == routes[np.int16].seq_slot))
+    assert bool(
+        jnp.all(routes[np.int32].seq_active == routes[np.int16].seq_active)
+    )
+    assert bool(jnp.all(routes[np.int32].nhop == routes[np.int16].nhop))
+
+
+# ---- build-time resolution: the knob never retraces ------------------------
+
+
+def test_layout_knob_no_retrace():
+    lay = resolve_layout("sparse")
+
+    @jax.jit
+    def decide(inst, jobs, key):
+        return baseline_policy(inst, jobs, key, layout=lay).decision.dst
+
+    key = jax.random.PRNGKey(0)
+    for seed in (0, 1, 2):
+        inst, jobs = _case(seed, lay)
+        decide(inst, jobs, key)
+    # same shapes, different data: one trace total — the policy is baked in
+    # at build time, never read inside the traced program
+    assert decide._cache_size() == 1
