@@ -96,6 +96,7 @@ def run_cycle(
     cycle: int = 0,
     inject_regression: bool = False,
     steady_after_validate: bool = False,
+    drift_monitor=None,
 ):
     """One full flywheel cycle; returns (record, next_id_offset)."""
     from multihop_offload_tpu.loop.experience import (
@@ -111,10 +112,30 @@ def run_cycle(
     record: dict = {"cycle": cycle}
 
     # ---- capture -----------------------------------------------------------
-    controller.transition("capturing", cycle=cycle)
-    responses, id_offset = _capture_window(
-        cfg, service, pool, cfg.loop_capture_requests, id_offset
-    )
+    if drift_monitor is None:
+        controller.transition("capturing", cycle=cycle)
+        responses, id_offset = _capture_window(
+            cfg, service, pool, cfg.loop_capture_requests, id_offset
+        )
+    else:
+        # drift-gated entry (--loop_drift): serve a window FIRST, feed the
+        # new outcomes to the detectors, and only open a capture cycle when
+        # one trips — otherwise the flywheel stays idle on this traffic
+        responses, id_offset = _capture_window(
+            cfg, service, pool, cfg.loop_capture_requests, id_offset
+        )
+        fresh = read_outcomes(cfg.obs_log)[drift_monitor.samples:]
+        trips = drift_monitor.feed(fresh)
+        record["drift"] = {
+            "samples": drift_monitor.samples,
+            "trips": trips,
+        }
+        if not trips:
+            controller.transition("idle", cycle=cycle, reason="no drift")
+            record["skipped"] = "no drift detected"
+            record["pre_tau"] = _window_tau(responses)
+            return record, id_offset
+        controller.drift_triggered(trips[0], cycle=cycle)
     pre_tau = _window_tau(responses)
     outcomes = read_outcomes(cfg.obs_log)
     record.update(served=len(responses), outcomes=len(outcomes),
@@ -174,6 +195,7 @@ def run_cycle(
             extra={"candidate_step": cand_step},
         ),
         candidate_step=cand_step,
+        experience_ids=[o.request.request_id for o in train],
     )
     record["promoted_step"] = step
     if step is None:
@@ -230,6 +252,11 @@ def run_loop(cfg: Config, inject_regression: bool = False,
     model = make_model(cfg)
     controller = PromotionController(cfg.model_dir())
     champion_step = _bootstrap_champion(cfg, service)
+    drift_monitor = None
+    if getattr(cfg, "loop_drift", False):
+        from multihop_offload_tpu.obs.drift import DriftMonitor
+
+        drift_monitor = DriftMonitor()
 
     cycles = []
     id_offset = 0
@@ -238,6 +265,7 @@ def run_loop(cfg: Config, inject_regression: bool = False,
             cfg, model, service, pool, controller, id_offset, cycle=c,
             inject_regression=inject_regression,
             steady_after_validate=steady_after_validate and c == 0,
+            drift_monitor=drift_monitor,
         )
         cycles.append(rec)
     return {

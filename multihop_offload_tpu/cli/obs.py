@@ -4,6 +4,8 @@
     mho-obs out/run.jsonl --json       # parsed {manifest, phases, metrics}
     mho-obs out/run.jsonl --prom FILE  # re-render the final metric snapshot
                                        # as Prometheus text exposition
+    mho-obs out/run.jsonl --trace 42   # one request's end-to-end hop chain
+                                       # (rotated segments included)
 
 Pure parsing — no jax initialization, safe on any host (including one whose
 accelerator is wedged: that is exactly when you want to read the log).
@@ -24,7 +26,16 @@ def main(argv=None) -> int:
     p.add_argument("--prom", default=None, metavar="FILE",
                    help="also write the run's final metric snapshot as "
                         "Prometheus text exposition ('-' for stdout)")
+    p.add_argument("--trace", default=None, type=int, metavar="REQUEST_ID",
+                   help="reconstruct one request's journey from the run "
+                        "log's trace hops instead of the report")
     args = p.parse_args(argv)
+
+    if args.trace is not None:
+        from multihop_offload_tpu.obs.trace import render_trace
+
+        print(render_trace(args.path, args.trace), end="")
+        return 0
 
     from multihop_offload_tpu.obs.report import load_run, render_report
 

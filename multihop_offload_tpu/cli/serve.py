@@ -20,9 +20,11 @@ import json
 from multihop_offload_tpu.config import Config, from_args
 
 
-def build_service(cfg: Config, pool=None):
+def build_service(cfg: Config, pool=None, clock=None):
     """Construct (service, pool) from config — shared by this CLI, the load
-    generator, and the smoke tests so every entry point wires the same way."""
+    generator, and the smoke tests so every entry point wires the same way.
+    `clock` overrides the service's time source (the health smoke drives a
+    manual clock through injected latency bursts)."""
     import jax
     import jax.numpy as jnp
 
@@ -50,6 +52,8 @@ def build_service(cfg: Config, pool=None):
         apsp_impl=cfg.apsp_impl, fp_impl=cfg.fp_impl,
         dtype=cfg.jnp_dtype, precision=cfg.precision_policy,
         capture_sample=cfg.loop_capture_sample,
+        trace=getattr(cfg, "obs_trace", True),
+        **({"clock": clock} if clock is not None else {}),
     )
     loaded = service.hot_reload(cfg.model_dir())
     print("serving with "

@@ -190,6 +190,12 @@ class Config:
     obs_prom: str = ""             # write the final metric-registry snapshot
     #                                as Prometheus text exposition to this
     #                                path at loop exit ("" = disabled)
+    obs_trace: bool = True         # request-scoped trace hops in the run log
+    #                                (submit/pack/dispatch/... events; only
+    #                                emitted when obs_log is active, so the
+    #                                default costs nothing without a log)
+    obs_flight_capacity: int = 256  # flight-recorder ring size (per-tick
+    #                                diagnostics retained for breach dumps)
     obs_log_max_bytes: int = 0     # size-cap per JSONL segment: when the
     #                                active run log would grow past this, it
     #                                is rotated to `<path>.NNNN` and a fresh
@@ -221,6 +227,14 @@ class Config:
     loop_sim_rounds: int = 2       # A/B validation sim: policy rounds
     loop_sim_slots: int = 200      # A/B validation sim: slots per round
     loop_out: str = ""             # write the cycle/smoke JSON record here
+    loop_drift: bool = False       # gate flywheel capture on obs.drift: a
+    #                                cycle only enters `capturing` when a
+    #                                detector trips on the outcome stream
+    #                                (`drift_triggered` transitions)
+    # ---- health (obs/slo + flightrec; `mho-health`) ------------------------
+    health_short_s: float = 60.0   # SLO burn-rate short window (seconds)
+    health_long_s: float = 300.0   # SLO burn-rate long window (seconds)
+    health_out: str = ""           # write the health-smoke JSON record here
 
     @property
     def jnp_dtype(self):

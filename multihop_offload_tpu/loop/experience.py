@@ -56,10 +56,15 @@ def sampled(request_id: int, rate: float) -> bool:
 
 def outcome_record(req: OffloadRequest, resp: OffloadResponse) -> dict:
     """JSON-safe fields of one captured outcome (the "outcome" event body)."""
+    from multihop_offload_tpu.obs.spans import current_trace_id
+
     job_total = np.asarray(resp.job_total, np.float64)
     topo = req.topo
     return {
         "request_id": int(req.request_id),
+        # the serving tick's span trace id: links this outcome to the
+        # request's trace hops (obs.trace / `mho-obs --trace`)
+        "trace_id": current_trace_id(),
         # topology as its edge list: adjacency (and everything derived)
         # rebuilds exactly via build_topology at read time
         "n": int(topo.n),

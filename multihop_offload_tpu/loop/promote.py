@@ -30,6 +30,7 @@ import jax
 import numpy as np
 
 from multihop_offload_tpu.obs import events as obs_events
+from multihop_offload_tpu.obs import trace as obs_trace
 from multihop_offload_tpu.obs.registry import registry as obs_registry
 from multihop_offload_tpu.serve.executor import param_signature
 from multihop_offload_tpu.train import checkpoints as ckpt_lib
@@ -66,6 +67,21 @@ class PromotionController:
     def _next_step(self) -> int:
         return (ckpt_lib.latest_step(self.directory) or 0) + 1
 
+    def drift_triggered(self, trip: dict, cycle: Optional[int] = None) -> None:
+        """Enter capture because a drift detector fired (obs.drift): the
+        flywheel's third entry path besides schedule and operator.  The
+        trip's signal/detector/stat land in the `loop_state` event so a
+        capture window is attributable to the shift that opened it."""
+        obs_registry().counter(
+            "mho_loop_drift_captures_total",
+            "capture windows opened by drift detectors",
+        ).inc(signal=str(trip.get("signal", "?")))
+        fields = {k: trip[k] for k in ("signal", "detector", "stat", "value")
+                  if k in trip}
+        if cycle is not None:
+            fields["cycle"] = cycle
+        self.transition("capturing", trigger="drift_triggered", **fields)
+
     # ---- the two weight-moving actions -------------------------------------
 
     def promote(
@@ -74,6 +90,7 @@ class PromotionController:
         candidate_variables: Any,
         lineage: Optional[dict] = None,
         candidate_step: Optional[int] = None,
+        experience_ids: Optional[List[int]] = None,
     ) -> Optional[int]:
         """Validated candidate -> serving tree -> hot-reload.
 
@@ -99,6 +116,11 @@ class PromotionController:
         ).inc()
         obs_events.emit("promotion", step=step, loaded=loaded,
                         candidate_step=candidate_step)
+        if experience_ids:
+            # close the trace loop: every captured request that trained this
+            # candidate gets a terminal "promotion" hop with its lineage
+            obs_trace.hop("promotion", experience_ids, step=step,
+                          candidate_step=candidate_step)
         self.transition("promoted", step=step)
         return step
 

@@ -34,6 +34,7 @@ from multihop_offload_tpu.loop.experience import (
     pad_for_outcomes,
     replay_batches,
 )
+from multihop_offload_tpu.obs import trace as obs_trace
 from multihop_offload_tpu.obs.registry import registry as obs_registry
 from multihop_offload_tpu.obs.spans import span
 from multihop_offload_tpu.train import checkpoints as ckpt_lib
@@ -68,6 +69,14 @@ def refit(
         batches = list(replay_batches(
             outcomes, pad, slots, dtype=cfg.jnp_dtype, hop_cache=hop_cache
         ))
+        # trace continuity: each captured request's journey records which
+        # refit batch its experience trained (obs.trace hop chain)
+        for bi in range(0, len(outcomes), slots):
+            obs_trace.hop(
+                "refit_batch",
+                [o.request.request_id for o in outcomes[bi:bi + slots]],
+                batch=bi // slots, slots=slots,
+            )
     optimizer = make_optimizer(cfg)
     params = variables["params"]
     opt_state = optimizer.init(params)

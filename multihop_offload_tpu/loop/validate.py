@@ -138,7 +138,10 @@ def ab_compare(
         )
         with span("loop/validate", arm=name, fleet=fleet):
             run = sim.run(insts, jobss, paramss, keys,
-                          init_rates=init_rates)
+                          init_rates=init_rates,
+                          request_ids=[o.request.request_id
+                                       for o in outcomes],
+                          tag=name)
         scores[name] = score_run(run.state, dts)
     scores["fleet"] = fleet
     scores["slots"] = rounds * slots_per_round

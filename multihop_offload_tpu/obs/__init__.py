@@ -9,6 +9,13 @@ via `jax.profiler.TraceAnnotation` (absorbing `utils.profiling`);
 `jaxhooks` counts retraces/compiles via `jax.monitoring` and attributes
 them to the active span's phase; `report` renders the JSONL into the
 human-readable run report (`mho-obs`).
+
+The health layer builds on those primitives: `slo` evaluates declarative
+objectives as multi-window burn rates over the registry, `trace` stamps
+request-scoped hop events through serve/sim/loop, `drift` watches the
+captured-experience stream for distribution shift, and `flightrec` keeps
+a bounded ring of tick diagnostics dumped as a debug bundle on breach
+(`mho-health` drives the closed-loop proof).
 """
 
 from multihop_offload_tpu.obs.events import (  # noqa: F401
@@ -22,6 +29,11 @@ from multihop_offload_tpu.obs.events import (  # noqa: F401
 from multihop_offload_tpu.obs.registry import (  # noqa: F401
     MetricRegistry,
     registry,
+)
+from multihop_offload_tpu.obs.slo import (  # noqa: F401
+    SLOEngine,
+    SLOSpec,
+    default_serving_slos,
 )
 from multihop_offload_tpu.obs.spans import (  # noqa: F401
     current_phase,

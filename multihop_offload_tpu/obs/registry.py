@@ -20,6 +20,32 @@ DEFAULT_BUCKETS = (
     5.0, 10.0, 30.0, 60.0,
 )
 
+
+def log_buckets(lo: float = 0.001, hi: float = 60.0,
+                per_decade: int = 4) -> Tuple[float, ...]:
+    """Log-spaced histogram boundaries, `per_decade` per decade, rounded to
+    3 significant digits (stable text exposition).  Constant RELATIVE
+    resolution: a p99 read out of these buckets has the same ~`10^(1/
+    per_decade)` error bound whether the tail sits at ~1 ms or ~1 s —
+    which a linear-ish ladder like `DEFAULT_BUCKETS` cannot give at both
+    scales at once."""
+    if not (0.0 < lo < hi):
+        raise ValueError("need 0 < lo < hi")
+    n = math.ceil(per_decade * math.log10(hi / lo))
+    out = []
+    for i in range(n + 1):
+        b = float(f"{min(lo * 10.0 ** (i / per_decade), hi):.3g}")
+        if not out or b > out[-1]:
+            out.append(b)
+    if out[-1] < hi:
+        out.append(float(hi))
+    return tuple(out)
+
+
+# the serving-latency preset (`mho_serve_*` histograms): sub-ms queueing on
+# a warm CPU host and multi-second degraded bursts land in the same metric
+LATENCY_BUCKETS = log_buckets(0.001, 60.0, per_decade=4)
+
 _LabelKey = Tuple[Tuple[str, str], ...]
 
 
@@ -63,10 +89,17 @@ class Counter(_Metric):
         with self._lock:
             return float(self._series.get(_label_key(labels), 0.0))
 
-    def total(self) -> float:
-        """Sum over every label combination."""
+    def total(self, **labels) -> float:
+        """Sum over every label combination; with labels given, over every
+        series whose label set CONTAINS them (subset match — what the SLO
+        engine needs to read e.g. `{outcome="admitted"}` regardless of any
+        other labels a series carries)."""
+        want = set(_label_key(labels))
         with self._lock:
-            return float(sum(self._series.values()))
+            if not want:
+                return float(sum(self._series.values()))
+            return float(sum(v for key, v in self._series.items()
+                             if want <= set(key)))
 
 
 class Gauge(_Metric):
@@ -139,6 +172,50 @@ class Histogram(_Metric):
                 "mean_s": s.sum / max(s.count, 1),
                 "min_s": s.min, "max_s": s.max,
             }
+
+    def _merged_counts(self):
+        """Per-bucket counts summed over every label set (caller holds no
+        lock; this takes it).  Last slot is the +Inf tail."""
+        merged = [0] * (len(self.buckets) + 1)
+        with self._lock:
+            for s in self._series.values():
+                for i, c in enumerate(s.bucket_counts):
+                    merged[i] += c
+        return merged
+
+    def le_total(self, le: float) -> Tuple[int, int]:
+        """(observations <= le, total observations) across ALL label sets —
+        the good/total pair the SLO burn-rate engine samples.  `le` snaps
+        DOWN to the nearest bucket boundary (conservative: never counts an
+        observation that might exceed the objective as good)."""
+        merged = self._merged_counts()
+        good = 0
+        for b, c in zip(self.buckets, merged):
+            if b > float(le):
+                break
+            good += c
+        return good, sum(merged)
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Histogram-interpolated quantile over all label sets (linear
+        within the containing bucket; the +Inf tail reports the max
+        observed).  None before any observation."""
+        merged = self._merged_counts()
+        total = sum(merged)
+        if total == 0:
+            return None
+        target = max(0.0, min(1.0, float(q))) * total
+        cum = 0
+        lo = 0.0
+        for b, c in zip(self.buckets, merged):
+            if cum + c >= target and c > 0:
+                frac = (target - cum) / c
+                return lo + frac * (b - lo)
+            cum += c
+            lo = b
+        with self._lock:
+            return max((s.max for s in self._series.values() if s.count),
+                       default=None)
 
 
 class MetricRegistry:

@@ -30,6 +30,12 @@ def classify_phase(name: str) -> str:
     return "other"
 
 
+# low-volume health events retained in full by load_run (an alert history
+# is only useful complete); absent in pre-health logs — every consumer
+# degrades to "no section" on an empty list
+_HEALTH_EVENTS = ("alert", "drift", "flight_record")
+
+
 def load_run(path: str) -> dict:
     """Parse a run.jsonl into {manifest, counts, phases, metrics, events}."""
     manifest: Optional[dict] = None
@@ -37,9 +43,12 @@ def load_run(path: str) -> dict:
     phases: Dict[str, dict] = {}
     metrics: Dict[str, dict] = {}
     last_of: Dict[str, dict] = {}
+    health: Dict[str, List[dict]] = {k: [] for k in _HEALTH_EVENTS}
     first_ts = last_ts = None
     for ev in read_events(path):
         et = ev.get("event", "?")
+        if et in health:
+            health[et].append(ev)
         ts = ev.get("ts")
         if isinstance(ts, (int, float)):
             first_ts = ts if first_ts is None else first_ts
@@ -71,6 +80,7 @@ def load_run(path: str) -> dict:
         "phases": phases,
         "metrics": metrics,
         "last": last_of,
+        "health": health,
         "wall_s": (last_ts - first_ts) if first_ts is not None else None,
     }
 
@@ -210,6 +220,42 @@ def render_report(path: str) -> str:
                     if ev.get(k) not in (None, "")
                 )
                 lines.append(f"  {f'last {et}':<42} {detail or '(recorded)'}")
+        lines.append("")
+
+    health = run.get("health") or {}
+    alerts = health.get("alert") or []
+    drifts = health.get("drift") or []
+    flights = health.get("flight_record") or []
+    if alerts or drifts or flights:
+        lines.append("alerts & drift")
+        if alerts:
+            rows = [[
+                a.get("name", "?"), a.get("state", "?"),
+                f"{a.get('at', 0.0):.3f}" if isinstance(
+                    a.get("at"), (int, float)) else "-",
+                a.get("burn_short", "-"), a.get("burn_long", "-"),
+            ] for a in alerts]
+            lines += ["  " + ln for ln in _table(
+                ["slo", "state", "at", "burn_short", "burn_long"], rows)]
+            firing = {a.get("name") for a in alerts
+                      if a.get("state") == "firing"}
+            firing -= {a.get("name") for a in alerts
+                       if a.get("state") == "resolved"}
+            lines.append("  still firing at log end: "
+                         + (", ".join(sorted(x for x in firing if x))
+                            or "(none)"))
+        for d in drifts:
+            lines.append(
+                f"  drift trip: {d.get('signal', '?')} via "
+                f"{d.get('detector', '?')} after {d.get('samples', '?')} "
+                f"samples (stat={d.get('stat', '?')})"
+            )
+        for fr in flights:
+            lines.append(
+                f"  flight bundle: {fr.get('path', '?')} "
+                f"({fr.get('records', '?')} records, "
+                f"reason={fr.get('reason', '?')})"
+            )
         lines.append("")
 
     mem = _counter_by_label(metrics, "mho_device_peak_bytes_in_use")

@@ -25,6 +25,7 @@ import numpy as np
 
 from multihop_offload_tpu.agent.policy import forward_env
 from multihop_offload_tpu.env.policies import baseline_policy
+from multihop_offload_tpu.obs import trace as obs_trace
 from multihop_offload_tpu.serve.bucketing import ShapeBuckets
 from multihop_offload_tpu.train import checkpoints as ckpt_lib
 
@@ -102,13 +103,23 @@ class BucketExecutor:
 
             self._steps[b] = (jax.jit(gnn_step), jax.jit(baseline_step))
 
-    def run(self, bucket: int, binst, bjobs, keys, degraded: bool = False):
+    def run(self, bucket: int, binst, bjobs, keys, degraded: bool = False,
+            request_ids=None):
         """One fused dispatch; returns host numpy (dst, is_local, delay_est,
-        job_total), each (slots, pad.j), via one bulk device->host fetch."""
+        job_total), each (slots, pad.j), via one bulk device->host fetch.
+        `request_ids` (when the service traces) stamps the batch with a
+        ``dispatch`` hop — which program ran, on which weights."""
         gnn, baseline = self._steps[bucket]
         out = (baseline(binst, bjobs, keys) if degraded
                else gnn(self.variables, binst, bjobs, keys))
         self.dispatch_count += 1
+        if request_ids:
+            obs_trace.hop(
+                "dispatch", request_ids, bucket=bucket,
+                dispatch=self.dispatch_count,
+                program="baseline" if degraded else "gnn",
+                step=self.loaded_step,
+            )
         return tuple(np.asarray(x) for x in jax.device_get(out))
 
     def hot_reload(self, model_dir: str, which: str = "orbax") -> Optional[int]:

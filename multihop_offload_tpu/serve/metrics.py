@@ -18,6 +18,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, List
 
+from multihop_offload_tpu.obs.registry import LATENCY_BUCKETS
 from multihop_offload_tpu.obs.registry import registry as _registry
 from multihop_offload_tpu.train.metrics import summarize_latencies
 from multihop_offload_tpu.train.tb_logging import ScalarLogger
@@ -103,8 +104,11 @@ class ServingStats:
                 "requests served by the analytic baseline under deadline "
                 "pressure",
             ).inc(n_real)
+        # log-spaced preset: p99 resolves at ~1 ms (warm ticks) AND ~1 s
+        # (degraded bursts) — the resolution the SLO engine alerts on
         lat = reg.histogram(
-            "mho_serve_latency_seconds", "request queue+serve latency"
+            "mho_serve_latency_seconds", "request queue+serve latency",
+            buckets=LATENCY_BUCKETS,
         )
         for x in latencies_s:
             lat.observe(x)

@@ -27,6 +27,7 @@ import jax
 import numpy as np
 
 from multihop_offload_tpu.obs import events as obs_events
+from multihop_offload_tpu.obs import trace as obs_trace
 from multihop_offload_tpu.obs.registry import registry as obs_registry
 from multihop_offload_tpu.obs.spans import span
 from multihop_offload_tpu.serve.bucketing import (
@@ -63,6 +64,7 @@ class OffloadService:
         layout=None,
         clock: Callable[[], float] = time.monotonic,
         capture_sample: float = 0.0,
+        trace: bool = True,
     ):
         from multihop_offload_tpu.layouts import resolve_layout
         from multihop_offload_tpu.precision import resolve_precision
@@ -93,6 +95,13 @@ class OffloadService:
         # learning flywheel's input; 0 = off).  Deterministic per request
         # id — see loop.experience.sampled.
         self.capture_sample = float(capture_sample)
+        # request-scoped tracing (obs.trace): batched hop events through the
+        # active run log; with no log installed the knob costs one bool check
+        self.trace = bool(trace)
+        # health hook (attach_health): an SLO engine observed once per tick
+        # and a flight recorder fed one diagnostic row per tick
+        self.slo = None
+        self.recorder = None
         self.stats = ServingStats()
         self._queues: List[Deque[Tuple[OffloadRequest, float]]] = [
             deque() for _ in buckets.pads
@@ -124,7 +133,21 @@ class OffloadService:
         obs_registry().gauge(
             "mho_serve_queue_depth", "pending admitted requests"
         ).set(self.queue_depth)
+        if self._tracing():
+            obs_trace.hop("submit", [req.request_id], bucket=b,
+                          queue_depth=self.queue_depth)
         return True
+
+    def _tracing(self) -> bool:
+        return self.trace and obs_events.get_run_log() is not None
+
+    def attach_health(self, slo=None, recorder=None) -> None:
+        """Wire the health subsystem into the tick: `slo` (an
+        `obs.slo.SLOEngine`) is observed once per tick on the service
+        clock; `recorder` (an `obs.flightrec.FlightRecorder`) receives one
+        diagnostic row per tick.  Either may be None."""
+        self.slo = slo
+        self.recorder = recorder
 
     def _sparse_fit(self, req: OffloadRequest, b: int) -> Optional[int]:
         """Escalate to the first bucket whose STATIC nnz pads also hold this
@@ -164,22 +187,34 @@ class OffloadService:
                 taken = [q.popleft() for _ in range(min(self.slots, len(q)))]
                 reqs = [r for r, _ in taken]
                 pad = self.buckets[b]
+                tracing = self._tracing()
+                ids = [r.request_id for r in reqs] if tracing else None
                 with span("serve/pack"):
                     binst, bjobs = pack_bucket(
                         reqs, pad, self.slots, dtype=self.dtype,
                         hop_cache=self._hop_cache, layout=self.layout,
                     )
+                if tracing:
+                    obs_trace.hop("pack", ids, bucket=b,
+                                  degraded=bool(degraded))
                 keys = [self.request_key(r.request_id) for r in reqs]
                 while len(keys) < self.slots:   # pad slots reuse the last key
                     keys.append(keys[-1])
                 out = self.executor.run(
                     b, binst, bjobs, np.stack([np.asarray(k) for k in keys]),
-                    degraded=degraded,
+                    degraded=degraded, request_ids=ids,
                 )
                 t_done = self.clock() if now is None else now
                 batch_responses = demux_responses(
                     taken, out, "baseline" if degraded else "gnn", b, t_done
                 )
+                if tracing:
+                    obs_trace.hop(
+                        "decision", ids, bucket=b,
+                        served_by="baseline" if degraded else "gnn",
+                        latency_s=[round(r.latency_s, 6)
+                                   for r in batch_responses],
+                    )
                 responses.extend(batch_responses)
                 self._capture_outcomes(reqs, batch_responses)
                 waste = padding_waste(reqs, pad, self.slots)
@@ -199,6 +234,15 @@ class OffloadService:
                 "tick", n=self.stats.ticks, served=len(responses),
                 degraded_batches=degraded_batches, queue_depth=depth,
             )
+        if self.recorder is not None:
+            lat = [r.latency_s for r in responses]
+            self.recorder.record(
+                "tick", tick=self.stats.ticks, served=len(responses),
+                degraded_batches=degraded_batches, queue_depth=depth,
+                latency_max_s=round(max(lat), 6) if lat else 0.0,
+            )
+        if self.slo is not None:
+            self.slo.observe(self.clock() if now is None else now)
         return responses
 
     def _capture_outcomes(self, reqs, batch_responses) -> None:
@@ -210,12 +254,17 @@ class OffloadService:
         from multihop_offload_tpu.loop import experience
 
         captured = 0
+        captured_ids = []
         for req, resp in zip(reqs, batch_responses):
             if experience.sampled(req.request_id, self.capture_sample):
                 obs_events.emit(
                     "outcome", **experience.outcome_record(req, resp)
                 )
                 captured += 1
+                captured_ids.append(req.request_id)
+        if captured and self.trace:
+            obs_trace.hop("capture", captured_ids,
+                          sample=self.capture_sample)
         if captured:
             obs_registry().counter(
                 "mho_serve_outcomes_captured_total",

@@ -31,10 +31,12 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import struct
 
 from multihop_offload_tpu.graphs.instance import Instance, JobSet
 from multihop_offload_tpu.obs import jaxhooks
+from multihop_offload_tpu.obs import trace as obs_trace
 from multihop_offload_tpu.obs.registry import registry
 from multihop_offload_tpu.obs.spans import span
 from multihop_offload_tpu.sim.state import (
@@ -166,8 +168,14 @@ class FleetSim:
         keys: jax.Array,
         states: SimState | None = None,
         init_rates: jnp.ndarray | None = None,
+        request_ids=None,
+        tag: str = "",
     ) -> SimRun:
-        """Simulate one segment for the whole (stacked) fleet."""
+        """Simulate one segment for the whole (stacked) fleet.
+
+        `request_ids` (one per lane, e.g. the held-out requests an A/B
+        validation replays) stamps a per-lane ``sim_outcome`` trace hop so
+        a traced request's journey includes its simulated fate."""
         fleet = int(keys.shape[0])
         if states is None:
             states = self.init_states(fleet)
@@ -198,6 +206,14 @@ class FleetSim:
         reg.gauge(
             "mho_sim_in_flight", "packets queued at segment end"
         ).set(int(jnp.sum(out.state.count[..., :-1])))
+        if request_ids:
+            st = jax.tree_util.tree_map(np.asarray, out.state)
+            obs_trace.hop(
+                "sim_outcome", request_ids, tag=tag,
+                delivered=st.delivered.sum(axis=1).astype(int).tolist(),
+                dropped=st.dropped.sum(axis=1).astype(int).tolist(),
+                generated=st.generated.sum(axis=1).astype(int).tolist(),
+            )
         return out
 
     def mark_steady(self) -> None:

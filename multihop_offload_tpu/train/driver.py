@@ -64,10 +64,10 @@ def _init_params(cfg: Config, model, example, model_dir: Optional[str]):
     if model_dir and os.path.isfile(os.path.join(model_dir, "checkpoint")):
         try:
             vs = load_reference_checkpoint(model_dir, dtype=cfg.jnp_dtype)
-            print(f"loaded reference-format weights from {model_dir}")
+            print(f"loaded reference-format weights from {model_dir}")  # print-ok(operator feedback at startup)
             return vs, True
         except Exception as e:  # pragma: no cover
-            print(f"unable to load {model_dir}: {e}")
+            print(f"unable to load {model_dir}: {e}")  # print-ok(operator feedback at startup)
     return model.init(jax.random.PRNGKey(cfg.seed), feats, support), False
 
 
@@ -396,7 +396,8 @@ class _Harness:
                 lambda t, r: np.asarray(r, dtype=np.asarray(t).dtype),
                 cur, rebuilt,
             )
-            print("checkpoint optimizer state does not match current config; "
+            print(  # print-ok(operator feedback on restore)
+                "checkpoint optimizer state does not match current config; "
                   "restored params only (fresh optimizer state)")
         else:
             self.opt_state = restored["opt_state"]
@@ -709,7 +710,7 @@ class Trainer(_Harness):
                                           source="offline")
                     explore = float(np.clip(explore * cfg.explore_decay, 0.0, 1.0))
                     if verbose:
-                        print(f"{gidx} Loss: {np.nanmean(losses):.2f}, "
+                        print(f"{gidx} Loss: {np.nanmean(losses):.2f}, "  # print-ok(verbose console)
                               f"explore: {explore:.4f}")
                     if tb.active:
                         tb.log_scalar("replay_loss", loss, gidx)
@@ -870,7 +871,7 @@ class Evaluator(_Harness):
                 rows += _rows(rec, counts, metrics, runtime, fid,
                               algo_col="Algo", fid_col=False)
                 if verbose and i % 50 == 0:
-                    print(f"[{i + 1}/{len(fids)}] {rec.filename} "
+                    print(f"[{i + 1}/{len(fids)}] {rec.filename} "  # print-ok(verbose console)
                           f"({wall:.3f}s for {3 * cfg.num_instances} evals)")
                 if runlog is not None:
                     runlog.step(fid=fid, wall_s=round(wall, 6),
@@ -958,7 +959,7 @@ class Evaluator(_Harness):
                 )
             done += real
             if verbose:
-                print(f"[{done}/{n_files}] bucket {bucket} chunk of {real} "
+                print(f"[{done}/{n_files}] bucket {bucket} chunk of {real} "  # print-ok(verbose console)
                       f"({wall:.3f}s, chunk {self.eval_chunk} "
                       f"on {self.n_dp} devices)")
             if runlog is not None:

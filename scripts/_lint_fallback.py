@@ -19,6 +19,15 @@ A deliberate fp32 island is waived per line with an explicit reason:
 
 `precision.py` itself (the policy definition) is exempt.
 
+`--prints` runs the observability rule (OB001, ruff's T20 class): library
+code under `multihop_offload_tpu/` must not write to stdout with a bare
+`print(` — telemetry goes through the run log / metric registry (`obs/`)
+so it survives redirection, rotation, and `mho-obs`.  CLI entry points
+(`multihop_offload_tpu/cli/`) are the console surface and are exempt.  A
+deliberate operator-facing print is waived per line with a reason:
+
+    print(f"loaded weights from {d}")  # print-ok(driver REPL feedback)
+
 `--layout` runs the sparse-layout rule (SL001, same shape as MP001):
 hot-path modules (env/ models/ serve/ sim/) must not materialize new dense
 square (N, N)-style arrays — instance structure flows through the padded
@@ -50,6 +59,12 @@ _SQUARE_DENSE = re.compile(
     r"\(\s*([A-Za-z_][\w.]*)\s*,\s*\1\s*[,)]"
 )
 _LAYOUT_WAIVER = "# dense-ok("
+
+# bare call only: `print(` not preceded by `.` (method) or a word char,
+# so `pprint(`, `self.print(` and `builtins.print(` don't match
+_PRINT_CALL = re.compile(r"(?<![\w.])print\s*\(")
+_PRINT_WAIVER = "# print-ok("
+PRINT_EXEMPT = os.path.join("multihop_offload_tpu", "cli") + os.sep
 
 
 def _py_files(roots):
@@ -194,6 +209,25 @@ def check_layout_file(path: str):
     return findings
 
 
+def check_prints_file(path: str):
+    """OB001: bare `print(` in library code (see module docstring) — obs/
+    owns the telemetry surface.  Waive with `# print-ok(<why>)`."""
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    findings = []
+    for lineno, line in enumerate(src.splitlines(), 1):
+        code = line.split("#", 1)[0]
+        if not _PRINT_CALL.search(code):
+            continue
+        if _PRINT_WAIVER in line or "# noqa" in line:
+            continue
+        findings.append((lineno, (
+            "OB001 bare print() in library code — emit through the run log "
+            "or metric registry (obs/), or waive with '# print-ok(<why>)'"
+        )))
+    return findings
+
+
 def precision_roots(pkg="multihop_offload_tpu"):
     return [os.path.join(pkg, d) for d in PRECISION_HOT_DIRS]
 
@@ -210,11 +244,16 @@ def main(argv):
     elif argv and argv[0] == "--layout":
         check = check_layout_file
         argv = argv[1:] or layout_roots()
+    elif argv and argv[0] == "--prints":
+        check = check_prints_file
+        argv = argv[1:] or ["multihop_offload_tpu"]
     roots = argv or ["multihop_offload_tpu"]
     total = 0
     for path in sorted(_py_files(roots)):
         if check is check_precision_file and \
                 os.path.basename(path) == "precision.py":
+            continue
+        if check is check_prints_file and PRINT_EXEMPT in path:
             continue
         for lineno, msg in sorted(check(path)):
             print(f"{path}:{lineno}: {msg}")

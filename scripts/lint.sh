@@ -11,7 +11,10 @@
 # waive fp32 islands with `# fp32-island(<why>)`) and the sparse-layout
 # rule (SL001: no new dense (N, N) materializations in hot-path modules —
 # waive with `# dense-ok(<why>)`) have no ruff equivalent and run on BOTH
-# branches.  Exit 0 = clean.
+# branches.  The observability rule (OB001: no bare print() in library
+# code — telemetry goes through obs/; waive with `# print-ok(<why>)`) maps
+# to ruff's T20 class on the ruff branch and runs via the fallback
+# checker otherwise.  Exit 0 = clean.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -31,4 +34,11 @@ python scripts/_lint_fallback.py --precision
 # repo-specific: no new dense square (N, N) materializations in hot paths —
 # instance structure flows through layouts/ edge lists; waive deliberate
 # dense buffers with `# dense-ok(<why>)` (SL001)
-exec python scripts/_lint_fallback.py --layout
+python scripts/_lint_fallback.py --layout
+
+# library code must not print to stdout — the run log / registry is the
+# telemetry surface; CLI entry points exempt, waive with
+# `# print-ok(<why>)` (OB001).  The ruff branch enforces the same class
+# via T20 + per-file-ignores in pyproject.toml; the fallback rule is
+# authoritative in this container.
+exec python scripts/_lint_fallback.py --prints

@@ -14,7 +14,13 @@
 #   4. mho-loop --smoke              — the continual-learning flywheel end
 #      to end: capture -> refit -> sim-gated A/B -> promote through
 #      hot-reload (zero unexpected retraces) -> injected regression ->
-#      automatic rollback; writes benchmarks/loop_smoke.json.
+#      automatic rollback; writes benchmarks/loop_smoke.json;
+#   5. mho-health --smoke            — the health subsystem's closed-loop
+#      breach drill: injected latency/overload burst -> SLO alert fires ->
+#      flight-recorder bundle dumps -> recovery resolves the alert ->
+#      drift detectors trip -> drift-triggered capture -> refit ->
+#      promote, with one request traced submit -> ... -> promotion across
+#      rotated log segments; writes benchmarks/health_smoke.json.
 #
 # This is the tier-1-ADJACENT gate (ROADMAP "quick checks") — it does not
 # replace the pytest tier-1 run.
@@ -23,16 +29,19 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
-echo "== [1/4] lint =="
+echo "== [1/5] lint =="
 bash scripts/lint.sh
 
-echo "== [2/4] mho-sim --smoke =="
+echo "== [2/5] mho-sim --smoke =="
 python -m multihop_offload_tpu.cli.sim --smoke
 
-echo "== [3/4] mho-sim --smoke --layout sparse =="
+echo "== [3/5] mho-sim --smoke --layout sparse =="
 python -m multihop_offload_tpu.cli.sim --smoke --layout sparse
 
-echo "== [4/4] mho-loop --smoke =="
+echo "== [4/5] mho-loop --smoke =="
 python -m multihop_offload_tpu.cli.loop --smoke
+
+echo "== [5/5] mho-health --smoke =="
+python -m multihop_offload_tpu.cli.health --smoke
 
 echo "smoke: all green"

@@ -1,0 +1,387 @@
+"""obs/ health layer: SLO burn rates, request tracing, drift, flight recorder."""
+
+import json
+import os
+
+import pytest
+
+from multihop_offload_tpu.obs import events as obs_events
+from multihop_offload_tpu.obs.drift import (
+    DriftMonitor,
+    EWMADetector,
+    PageHinkley,
+    outcome_features,
+)
+from multihop_offload_tpu.obs.events import RunLog, segment_paths
+from multihop_offload_tpu.obs.flightrec import FlightRecorder
+from multihop_offload_tpu.obs.registry import (
+    LATENCY_BUCKETS,
+    MetricRegistry,
+    log_buckets,
+)
+from multihop_offload_tpu.obs.slo import (
+    SLOEngine,
+    SLOSpec,
+    default_serving_slos,
+)
+from multihop_offload_tpu.obs.trace import hop, reconstruct, render_trace
+
+
+# ---- registry additions -----------------------------------------------------
+
+def test_log_buckets_preset_shape():
+    lb = log_buckets(0.001, 60.0, per_decade=4)
+    assert lb[0] == 0.001 and lb[-1] == 60.0
+    assert all(a < b for a, b in zip(lb, lb[1:]))
+    # constant relative resolution: every step within ~10^(1/4), modulo the
+    # 3-sig-fig rounding and the final snap to `hi`
+    for a, b in zip(lb, lb[1:]):
+        assert 1.0 < b / a < 2.2
+    assert LATENCY_BUCKETS == lb
+    with pytest.raises(ValueError):
+        log_buckets(0.0, 1.0)
+    with pytest.raises(ValueError):
+        log_buckets(2.0, 1.0)
+
+
+def test_histogram_le_total_snaps_down_and_quantile():
+    reg = MetricRegistry()
+    h = reg.histogram("lat_seconds", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 2.0):
+        h.observe(v)
+    assert h.le_total(0.1) == (1, 3)
+    assert h.le_total(0.5) == (1, 3)     # snaps DOWN to 0.1 (conservative)
+    assert h.le_total(1.0) == (2, 3)
+    assert h.le_total(0.05) == (0, 3)    # below the first boundary
+    assert h.quantile(0.5) == pytest.approx(0.55)  # interpolated in (0.1, 1]
+    assert h.quantile(0.99) == pytest.approx(2.0)  # +Inf tail -> observed max
+    assert reg.histogram("empty_seconds").quantile(0.5) is None
+
+
+def test_counter_total_subset_label_filter():
+    reg = MetricRegistry()
+    c = reg.counter("sub_total")
+    c.inc(3, outcome="admitted", bucket="0")
+    c.inc(4, outcome="admitted", bucket="1")
+    c.inc(2, outcome="backpressure")
+    assert c.total() == 9
+    assert c.total(outcome="admitted") == 7
+    assert c.total(outcome="backpressure") == 2
+    assert c.total(outcome="nope") == 0
+
+
+# ---- SLO burn-rate engine ---------------------------------------------------
+
+def test_window_error_math_on_synthetic_series():
+    samples = [(0.0, 0.0, 0.0), (10.0, 90.0, 100.0), (20.0, 90.0, 200.0)]
+    # window 10 at t=20: baseline is the t=10 sample -> 100 obs, 0 good
+    assert SLOEngine._window_error(samples, 20.0, 10.0) == pytest.approx(1.0)
+    # window 20 at t=20: baseline t=0 -> 200 obs, 90 good
+    assert SLOEngine._window_error(samples, 20.0, 20.0) == pytest.approx(0.55)
+    # fewer than two samples -> no evidence, no error
+    assert SLOEngine._window_error(samples[:1], 20.0, 10.0) == 0.0
+    # no traffic in the window -> 0, not NaN
+    flat = [(0.0, 5.0, 5.0), (10.0, 5.0, 5.0)]
+    assert SLOEngine._window_error(flat, 10.0, 10.0) == 0.0
+
+
+def test_slo_engine_fires_on_sustained_burn_and_resolves():
+    reg = MetricRegistry()
+    spec = SLOSpec("p99", "histogram_le", "lat_seconds",
+                   objective=0.9, le=0.1)
+    engine = SLOEngine([spec], registry=reg, short_s=10.0, long_s=30.0)
+    h = reg.histogram("lat_seconds", buckets=(0.1, 1.0))
+
+    transitions = []
+    breaches = []
+    engine.on_breach(lambda s, info: breaches.append((s.name, info["state"])))
+    t = 0.0
+    for _ in range(6):                      # calm: all under the bound
+        for _ in range(10):
+            h.observe(0.05)
+        transitions += engine.observe(t)
+        t += 1.0
+    assert transitions == [] and breaches == []
+    for _ in range(4):                      # burst: all over the bound
+        for _ in range(10):
+            h.observe(0.5)
+        transitions += engine.observe(t)
+        t += 1.0
+    firing = [x for x in transitions if x["state"] == "firing"]
+    assert len(firing) == 1 and firing[0]["name"] == "p99"
+    assert firing[0]["burn_short"] > 1.0 and firing[0]["burn_long"] > 1.0
+    assert breaches == [("p99", "firing")]
+    assert reg.gauge("mho_alert_active").value(slo="p99") == 1
+
+    for _ in range(15):                     # recovery: good traffic only
+        for _ in range(10):
+            h.observe(0.05)
+        transitions += engine.observe(t)
+        t += 1.0
+    resolved = [x for x in transitions if x["state"] == "resolved"]
+    assert len(resolved) == 1
+    assert reg.gauge("mho_alert_active").value(slo="p99") == 0
+    assert breaches == [("p99", "firing")]  # resolve is not a breach
+    assert engine.state()["p99"]["state"] == "ok"
+
+
+def test_slo_engine_short_spike_does_not_page():
+    # one bad tick trips the short window but not the long one -> no alert
+    reg = MetricRegistry()
+    spec = SLOSpec("p99", "histogram_le", "lat_seconds",
+                   objective=0.99, le=0.1)
+    engine = SLOEngine([spec], registry=reg, short_s=4.0, long_s=100.0)
+    h = reg.histogram("lat_seconds", buckets=(0.1, 1.0))
+    transitions = []
+    for t in range(51):
+        for _ in range(9):
+            h.observe(0.05)
+        h.observe(0.5 if t == 50 else 0.05)
+        transitions += engine.observe(float(t))
+    assert transitions == []
+    short, long_ = engine.burn_rates("p99", 50.0)
+    assert short > 1.0 and long_ <= 1.0
+
+
+def test_slo_counter_zero_fires_on_any_increment():
+    reg = MetricRegistry()
+    spec = SLOSpec("no_retrace", "counter_zero", "retr_total", objective=1.0)
+    engine = SLOEngine([spec], registry=reg, short_s=2.0, long_s=4.0)
+    transitions = []
+    for t in range(5):
+        transitions += engine.observe(float(t))
+    assert transitions == []
+    reg.counter("retr_total").inc()
+    transitions += engine.observe(5.0)
+    assert [x["state"] for x in transitions] == ["firing"]
+    for t in range(6, 12):                  # counter quiet again -> resolve
+        transitions += engine.observe(float(t))
+    assert [x["state"] for x in transitions] == ["firing", "resolved"]
+
+
+def test_slo_gauge_max_fires_above_bound():
+    reg = MetricRegistry()
+    spec = SLOSpec("queue", "gauge_max", "depth", objective=0.5, bound=5.0)
+    engine = SLOEngine([spec], registry=reg, short_s=2.0, long_s=4.0)
+    reg.gauge("depth").set(10.0)
+    transitions = []
+    for t in range(3):
+        transitions += engine.observe(float(t))
+    assert any(x["state"] == "firing" for x in transitions)
+
+
+def test_default_serving_slos_cover_the_issue_set():
+    specs = {s.name: s for s in default_serving_slos()}
+    assert set(specs) == {
+        "serve_p99", "serve_delivered", "serve_drops", "serve_queue",
+        "zero_unexpected_retraces",
+    }
+    assert specs["serve_p99"].kind == "histogram_le"
+    assert specs["serve_p99"].le == 0.25
+    assert specs["zero_unexpected_retraces"].objective == 1.0
+    with pytest.raises(ValueError):
+        SLOSpec("bad", "nope", "m", objective=0.9)
+    with pytest.raises(ValueError):
+        SLOSpec("bad", "ratio", "m", objective=0.0)
+
+
+# ---- request-scoped tracing -------------------------------------------------
+
+def test_trace_hop_is_noop_without_run_log():
+    assert obs_events.get_run_log() is None
+    hop("submit", [1, 2], bucket=0)  # must not raise
+
+
+def test_trace_reconstruct_across_rotated_segments(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    log = RunLog(path, manifest={"event": "manifest", "ts": 0},
+                 max_bytes=512)
+    obs_events.set_run_log(log)
+    try:
+        hop("submit", [7], bucket=0, queue_depth=1)
+        hop("pack", [5, 7, 9], bucket=0, degraded=False)
+        for i in range(30):   # filler traffic forces several rotations
+            hop("decision", [1000 + i], bucket=0,
+                latency_s=[0.001], served_by="gnn")
+        hop("decision", [5, 7, 9], bucket=0,
+            latency_s=[0.01, 0.02, 0.03], served_by="gnn")
+        hop("promotion", [7, 9], step=2, candidate_step=1)
+    finally:
+        obs_events.set_run_log(None)
+        log.close()
+
+    assert len(segment_paths(path)) >= 2
+    hops = reconstruct(path, 7)
+    assert [h["hop"] for h in hops] == [
+        "submit", "pack", "decision", "promotion",
+    ]
+    # aligned list columns flatten to this request's own element
+    assert hops[2]["latency_s"] == pytest.approx(0.02)
+    assert hops[2]["batch"] == 3
+    # scalar fields pass through untouched
+    assert hops[3]["step"] == 2
+    assert reconstruct(path, 4242) == []
+
+    text = render_trace(path, 7)
+    assert "4 hops" in text and "promotion" in text
+    assert "no trace events" in render_trace(path, 4242)
+
+    from multihop_offload_tpu.cli.obs import main as obs_main
+
+    assert obs_main([path, "--trace", "7"]) == 0
+
+
+# ---- drift detectors --------------------------------------------------------
+
+def test_page_hinkley_trips_on_shift_not_on_stationary():
+    det = PageHinkley(delta=0.2, threshold=12.0, min_samples=16)
+    stationary = [0.4, 0.6] * 60
+    assert not any(det.update(x) for x in stationary)
+    assert not det.tripped
+
+    det2 = PageHinkley(delta=0.2, threshold=12.0, min_samples=16)
+    for x in [0.4, 0.6] * 8:                # warmup: mu=0.5, small sigma
+        assert not det2.update(x)
+    trips = [det2.update(3.0) for _ in range(10)]
+    assert any(trips)
+    assert trips.count(True) == 1           # True exactly once (latched)
+    assert det2.tripped
+    with pytest.raises(ValueError):
+        PageHinkley(min_samples=1)
+
+
+def test_ewma_detector_trips_after_patience_run():
+    det = EWMADetector(alpha=0.01, k=4.0, min_samples=8, patience=3)
+    for x in [0.4, 0.6] * 30:
+        assert not det.update(x)
+    det2 = EWMADetector(alpha=0.01, k=4.0, min_samples=8, patience=3)
+    for x in [0.4, 0.6] * 4:
+        det2.update(x)
+    trips = [det2.update(50.0) for _ in range(5)]
+    assert any(trips) and trips.count(True) == 1
+    with pytest.raises(ValueError):
+        EWMADetector(alpha=0.0)
+
+
+def test_outcome_features_from_event_dict():
+    f = outcome_features({
+        "tau": 3.5, "is_local": [True, False, False, False],
+        "job_rate": [1.0, 2.0, 0.5],
+    })
+    assert f["tau"] == 3.5
+    assert f["offload_frac"] == pytest.approx(0.75)
+    assert f["arrival_rate"] == pytest.approx(3.5)
+
+
+def test_drift_monitor_trips_latch_and_count():
+    reg_outcomes = [
+        {"tau": 1.0 + 0.01 * (i % 3), "is_local": [True, False],
+         "job_rate": [0.5, 0.5]}
+        for i in range(24)
+    ]
+    shifted = [
+        {"tau": 40.0, "is_local": [True, False], "job_rate": [6.0, 6.0]}
+        for _ in range(20)
+    ]
+    mon = DriftMonitor(min_samples=16)
+    assert mon.feed(reg_outcomes) == []
+    trips = mon.feed(shifted)
+    signals = {t["signal"] for t in trips}
+    assert "tau" in signals and "arrival_rate" in signals
+    assert mon.samples == 44
+    # latched: the same shift reported once, not once per sample
+    assert mon.feed(shifted) == []
+    assert mon.trips == trips
+    mon.reset()
+    assert all(not d.tripped for d in mon.detectors.values())
+
+
+# ---- flight recorder --------------------------------------------------------
+
+def test_flight_recorder_ring_evicts_oldest():
+    t = {"now": 0.0}
+    rec = FlightRecorder(capacity=3, clock=lambda: t["now"])
+    for i in range(7):
+        t["now"] = float(i)
+        rec.record("tick", tick=i)
+    assert len(rec) == 3
+    assert [r["tick"] for r in rec.records()] == [4, 5, 6]
+    assert [r["ts"] for r in rec.records()] == [4.0, 5.0, 6.0]
+    with pytest.raises(ValueError):
+        FlightRecorder(capacity=0)
+
+
+def test_flight_recorder_dump_bundle_and_failure(tmp_path):
+    rec = FlightRecorder(capacity=4, clock=lambda: 123.0)
+    for i in range(6):
+        rec.record("tick", tick=i, queue_depth=i * 2)
+    out = rec.dump(str(tmp_path), "serve_p99 breach!",
+                   alerts={"serve_p99": {"state": "firing"}},
+                   extra={"note": "drill"})
+    assert os.path.basename(out) == "flight-001-serve_p99-breach"
+    rows = [json.loads(ln) for ln in
+            open(os.path.join(out, "records.jsonl"))]
+    assert [r["tick"] for r in rows] == [2, 3, 4, 5]
+    meta = json.load(open(os.path.join(out, "bundle.json")))
+    assert meta["reason"] == "serve_p99 breach!"
+    assert meta["records"] == 4 and meta["capacity"] == 4
+    assert meta["alerts"]["serve_p99"]["state"] == "firing"
+    assert meta["note"] == "drill"
+    assert os.path.getsize(os.path.join(out, "metrics.prom")) >= 0
+
+    out2 = rec.dump(str(tmp_path), "again")
+    assert os.path.basename(out2) == "flight-002-again"
+
+    # an unwritable target reports a failure, never raises into the tick
+    blocker = tmp_path / "blocked"
+    blocker.write_text("not a directory")
+    assert rec.dump(str(blocker), "nope") == ""
+
+
+# ---- drift-triggered capture transition -------------------------------------
+
+def test_promotion_controller_drift_triggered(tmp_path):
+    from multihop_offload_tpu.loop.promote import PromotionController
+
+    c = PromotionController(str(tmp_path))
+    c.drift_triggered(
+        {"signal": "tau", "detector": "page_hinkley", "stat": 15.2,
+         "value": 3.3, "samples": 40},
+        cycle=2,
+    )
+    assert c.state == "capturing"
+    last = c.history[-1]
+    assert last["trigger"] == "drift_triggered"
+    assert last["signal"] == "tau" and last["cycle"] == 2
+
+
+# ---- report: alerts & drift section -----------------------------------------
+
+def test_report_renders_alerts_and_degrades_without_them(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    log = RunLog(path, manifest={"event": "manifest", "ts": 0, "role": "t"})
+    log.emit("alert", name="serve_p99", state="firing", at=5.0,
+             burn_short=12.0, burn_long=4.0)
+    log.emit("drift", signal="tau", detector="page_hinkley", samples=40,
+             stat=15.2)
+    log.emit("flight_record", path="/x/flight-001-serve_p99",
+             reason="serve_p99", records=64)
+    log.close()
+
+    from multihop_offload_tpu.obs.report import load_run, render_report
+
+    run = load_run(path)
+    assert len(run["health"]["alert"]) == 1
+    text = render_report(path)
+    assert "alerts & drift" in text
+    assert "serve_p99" in text and "firing" in text
+    assert "still firing at log end: serve_p99" in text
+    assert "drift trip: tau" in text
+    assert "flight-001-serve_p99" in text
+
+    # a pre-health log renders with no section and no crash
+    old = str(tmp_path / "old.jsonl")
+    log2 = RunLog(old, manifest={"event": "manifest", "ts": 0})
+    log2.tick(n=1, served=2, queue_depth=0)
+    log2.close()
+    assert "alerts & drift" not in render_report(old)

@@ -160,6 +160,43 @@ def test_runlog_jsonl_schema_roundtrip(tmp_path):
     assert all("ts" in r for r in rows)
 
 
+def test_runlog_rotation_exact_boundary_never_splits_a_segment(tmp_path):
+    """Regression guard for the rotation edge: a row landing EXACTLY on
+    `max_bytes` must complete the current segment (rotation is strictly
+    greater-than), and a ``segment`` header must only ever be a segment's
+    first row — never interleaved mid-file by a write racing the boundary."""
+    from multihop_offload_tpu.obs.events import segment_paths
+
+    path = str(tmp_path / "run.jsonl")
+    manifest = {"event": "manifest", "ts": 0}
+    len_m = len(json.dumps(manifest) + "\n")
+    # n stays single-digit so every tick row has identical length
+    len_t = len(json.dumps({"event": "tick", "ts": 1, "n": 1}) + "\n")
+    log = RunLog(path, manifest=manifest, max_bytes=len_m + 2 * len_t)
+    for n in range(1, 8):
+        log._write({"event": "tick", "ts": 1, "n": n})
+    log.close()
+
+    segs = segment_paths(path)
+    assert len(segs) >= 2
+    # the first segment holds the manifest plus BOTH ticks: the second tick
+    # ends exactly at max_bytes and must not have triggered a rotation
+    with open(segs[0]) as f:
+        first = [json.loads(ln) for ln in f if ln.strip()]
+    assert [r["event"] for r in first] == ["manifest", "tick", "tick"]
+    # headers only ever lead a segment
+    for seg in segs:
+        with open(seg) as f:
+            rows = [json.loads(ln) for ln in f if ln.strip()]
+        assert rows, f"empty segment {seg}"
+        for i, r in enumerate(rows):
+            if r["event"] == "segment":
+                assert i == 0, f"mid-segment header in {seg}"
+    # nothing lost or reordered across the chain
+    ns = [r["n"] for r in read_events(path) if r["event"] == "tick"]
+    assert ns == list(range(1, 8))
+
+
 def test_read_events_tolerates_truncated_tail(tmp_path):
     path = str(tmp_path / "run.jsonl")
     with open(path, "w") as f:
