@@ -143,7 +143,8 @@ def lambdas_to_delay_matrix(
     dmtx = dmtx.at[u, v].set(masked_link_delay)
     dmtx = dmtx.at[v, u].set(masked_link_delay)
     diag = jnp.where(inst.comp_mask, node_delay, jnp.inf)  # (`:270-274`)
-    dmtx = dmtx.at[jnp.arange(n), jnp.arange(n)].set(diag)
+    iota = jnp.arange(n, dtype=jnp.int32)
+    dmtx = dmtx.at[iota, iota].set(diag)
     return ActorOutput(
         delay_matrix=dmtx, link_delay=link_delay, node_delay=node_delay, lam=lam
     )
@@ -168,7 +169,7 @@ def compat_cycled_diagonal(inst: Instance, node_delay: jnp.ndarray) -> jnp.ndarr
     # compute-capable node ids, ascending, padded nodes last
     comp_idx = jnp.argsort(~inst.comp_mask, stable=True)
     ncomp = jnp.maximum(jnp.sum(inst.comp_mask), 1)
-    cyc = comp_idx[jnp.arange(n) % ncomp]
+    cyc = comp_idx[jnp.arange(n, dtype=jnp.int32) % ncomp]
     return node_delay[cyc]
 
 

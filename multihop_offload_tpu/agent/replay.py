@@ -44,8 +44,8 @@ def replay_init(params: Any, capacity: int) -> GradReplay:
     )
     return GradReplay(
         grads=zeros,
-        loss_critic=jnp.zeros((capacity,)),
-        loss_mse=jnp.zeros((capacity,)),
+        loss_critic=jnp.zeros((capacity,), jnp.float32),  # fp32-island(loss statistics)
+        loss_mse=jnp.zeros((capacity,), jnp.float32),  # fp32-island(loss statistics)
         count=jnp.zeros((), jnp.int32),
         ptr=jnp.zeros((), jnp.int32),
     )
@@ -155,7 +155,9 @@ def replay_apply(
     capacity = mem.loss_critic.shape[0]
     # uniform sample w/o replacement over the filled prefix via Gumbel top-k
     scores = jax.random.uniform(key, (capacity,))
-    scores = jnp.where(jnp.arange(capacity) < mem.count, scores, -jnp.inf)
+    scores = jnp.where(
+        jnp.arange(capacity, dtype=jnp.int32) < mem.count, scores, -jnp.inf
+    )
     _, idx = lax.top_k(scores, batch)
 
     def step(carry, i):

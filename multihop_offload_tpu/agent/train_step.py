@@ -196,7 +196,7 @@ def _suffix_bias_grad(
     """
     num_jobs = jobs.src.shape[0]
     num_slots = routes.inc_ext.shape[0]
-    cols = jnp.arange(num_jobs)
+    cols = jnp.arange(num_jobs, dtype=jnp.int32)
 
     a = routes.seq_active.astype(grad_routes.dtype)              # (H, J)
     picked = grad_routes[routes.seq_slot, cols[None, :]] * a     # (H, J)
@@ -254,7 +254,8 @@ def _grad_edge_to_distance(
     g = g.at[u, v].set(g_link)
     g = g.at[v, u].set(g_link)
     diag = jnp.where(inst.comp_mask, grad_edge[num_links:], 0.0)
-    g = g.at[jnp.arange(n), jnp.arange(n)].set(diag)
+    iota = jnp.arange(n, dtype=jnp.int32)
+    g = g.at[iota, iota].set(diag)
     return g
 
 

@@ -1,0 +1,29 @@
+"""mho-lint: the repo's JAX-aware static-analysis engine.
+
+AST-based (alias- and multi-line-aware) replacements for the old regex
+fallback rules plus the JAX-correctness tripwires every perf gate in this
+repo leans on: trace-safety (JX001), retrace hazards (JX002), dtype
+pinning (JX003), hot-loop host sync (JX004), and nondeterminism (JX005),
+alongside the original MP001/SL001/OB001 and the ruff-approximation
+E999/F401/F811 set.  Stdlib-only: the gate runs in containers without
+ruff or jax installed.  See docs/OPERATIONS.md "Static analysis".
+"""
+
+from multihop_offload_tpu.analysis.engine import (
+    Report,
+    run_analysis,
+    write_baseline,
+)
+from multihop_offload_tpu.analysis.reachability import ProjectIndex
+from multihop_offload_tpu.analysis.rules import (
+    Finding,
+    Rule,
+    all_rules,
+    get_rule,
+    resolve_select,
+)
+
+__all__ = [
+    "Report", "run_analysis", "write_baseline", "ProjectIndex",
+    "Finding", "Rule", "all_rules", "get_rule", "resolve_select",
+]

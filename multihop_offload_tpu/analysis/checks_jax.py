@@ -1,0 +1,428 @@
+"""The JAX-correctness rules (JX001–JX005) — see docs/OPERATIONS.md.
+
+JX001 trace-safety      Python control flow / concretization on traced
+                        values inside jit-reachable code
+JX002 retrace hazard    jit construction inside a loop, or jit over a
+                        fresh lambda built per call
+JX003 dtype pinning     jnp/np arange|zeros|ones without an explicit
+                        dtype in hot-path dirs (the sim/ i32-pin bug)
+JX004 host sync         device read-backs inside the serve tick / train
+                        step / sim step host loops
+JX005 nondeterminism    wall-clock / global-RNG calls in library code —
+                        clocks are injected (the health layer's
+                        convention), RNG is seeded
+
+JX001 runs a small intraprocedural taint pass over each jit-reachable
+function (see `reachability`): values produced by `jax.*` calls are
+*traced*; taint follows assignments, arithmetic, subscripts and method
+calls, and is DROPPED through static accessors (`.shape`, `.ndim`,
+`.dtype`, `.size`, `len()`) and by rebinding to an untraced value — so
+`if x.ndim == 2:` and a traced name shadowed by a Python int are not
+findings.  Function parameters are deliberately NOT taint seeds: static
+shape/config arguments branch all the time in this codebase; the bug
+class is branching on *array values*, which must flow through a jax op
+first.  This is a tripwire for the common spelling of each bug, not a
+soundness proof — `getattr` dances and data passed through containers
+escape it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Set
+
+from multihop_offload_tpu.analysis.modinfo import ModuleCtx
+from multihop_offload_tpu.analysis.rules import Finding, rule
+
+_ARRAY_NS = ("numpy", "jax.numpy")
+
+# attribute reads that yield STATIC (trace-time) values on traced arrays
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+# calls whose results are static regardless of argument taint
+_STATIC_CALLS = {"len", "isinstance", "range", "type", "getattr", "hasattr",
+                 "jax.numpy.shape", "jax.numpy.ndim", "jax.numpy.result_type"}
+
+JX003_DIRS = ("env", "models", "agent", "serve", "sim", "layouts",
+              "train", "loop")
+JX004_DIRS = ("serve", "sim", "train", "loop")
+
+_HOT_LOOP_NAMES = ("tick", "step", "drain")
+
+
+def _snippet(mod: ModuleCtx, node: ast.AST) -> str:
+    return mod.line(node.lineno).strip()
+
+
+# ---------------------------------------------------------------------------
+# JX001 — trace-safety
+# ---------------------------------------------------------------------------
+
+
+class _TaintPass:
+    """One function's worth of taint propagation + flag points."""
+
+    def __init__(self, mod: ModuleCtx, fn: ast.AST):
+        self.mod = mod
+        self.fn = fn
+        self.tainted: Set[str] = set()
+        self.findings: List[Finding] = []
+
+    # ---- expression taint --------------------------------------------------
+
+    def _call_canon(self, node: ast.Call):
+        if isinstance(node.func, (ast.Name, ast.Attribute)):
+            return self.mod.canonical(node.func)
+        return None
+
+    def is_tainted(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.Call):
+            canon = self._call_canon(node)
+            if canon in _STATIC_CALLS or (canon or "").split(".")[0] in (
+                    "len", "isinstance", "range"):
+                return False
+            # bool()/float()/int() concretize: flagged at the flag points,
+            # and their RESULT is a Python scalar again
+            if isinstance(node.func, ast.Name) and node.func.id in (
+                    "bool", "float", "int"):
+                return False
+            if canon and canon.startswith("jax."):
+                return True
+            if isinstance(node.func, ast.Attribute) \
+                    and self.is_tainted(node.func):
+                return True  # tainted.sum() and friends
+            return any(self.is_tainted(a) for a in node.args) or any(
+                self.is_tainted(k.value) for k in node.keywords)
+        if isinstance(node, (ast.BinOp,)):
+            return self.is_tainted(node.left) or self.is_tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.is_tainted(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self.is_tainted(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            return self.is_tainted(node.left) or any(
+                self.is_tainted(c) for c in node.comparators)
+        if isinstance(node, ast.IfExp):
+            return (self.is_tainted(node.body) or self.is_tainted(node.test)
+                    or self.is_tainted(node.orelse))
+        if isinstance(node, ast.Subscript):
+            return self.is_tainted(node.value)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self.is_tainted(e) for e in node.elts)
+        if isinstance(node, ast.Starred):
+            return self.is_tainted(node.value)
+        return False
+
+    # ---- flag points -------------------------------------------------------
+
+    def _flag(self, node: ast.AST, what: str) -> None:
+        self.findings.append(Finding(
+            rule="JX001", path=self.mod.path, line=node.lineno,
+            message=(f"{what} on a traced value in jit-reachable code — "
+                     "use lax.cond/jnp.where (or hoist to the host), or "
+                     "waive with '# trace-ok(<why>)'"),
+            snippet=_snippet(self.mod, node),
+        ))
+
+    def _scan_expr(self, node: ast.AST) -> None:
+        """Find concretization calls anywhere inside an expression."""
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            if isinstance(sub.func, ast.Name) and sub.func.id in (
+                    "bool", "float", "int") and sub.args:
+                if self.is_tainted(sub.args[0]):
+                    self._flag(sub, f"{sub.func.id}()")
+            elif isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr == "item" and not sub.args:
+                self._flag(sub, ".item()")
+
+    # ---- statement walk ----------------------------------------------------
+
+    def _bind(self, target: ast.AST, tainted: bool) -> None:
+        if isinstance(target, ast.Name):
+            (self.tainted.add if tainted
+             else self.tainted.discard)(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._bind(e, tainted)
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value, tainted)
+
+    def run(self) -> List[Finding]:
+        body = getattr(self.fn, "body", [])
+        if isinstance(body, ast.AST):     # lambda
+            self._scan_expr(body)
+            return self.findings
+        self._stmts(body)
+        return self.findings
+
+    def _stmts(self, stmts) -> None:
+        for st in stmts:
+            self._stmt(st)
+
+    def _stmt(self, st: ast.AST) -> None:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            return  # nested defs are analyzed as their own reachable entries
+        if isinstance(st, ast.Assign):
+            self._scan_expr(st.value)
+            t = self.is_tainted(st.value)
+            for tgt in st.targets:
+                self._bind(tgt, t)
+        elif isinstance(st, ast.AnnAssign) and st.value is not None:
+            self._scan_expr(st.value)
+            self._bind(st.target, self.is_tainted(st.value))
+        elif isinstance(st, ast.AugAssign):
+            self._scan_expr(st.value)
+            if self.is_tainted(st.value):
+                self._bind(st.target, True)
+        elif isinstance(st, (ast.If, ast.While)):
+            self._scan_expr(st.test)
+            if self.is_tainted(st.test):
+                kind = "if" if isinstance(st, ast.If) else "while"
+                self._flag(st.test, f"Python `{kind}`")
+            # two passes over loop bodies to catch loop-carried taint
+            rounds = 2 if isinstance(st, ast.While) else 1
+            for _ in range(rounds):
+                self._stmts(st.body)
+            self._stmts(st.orelse)
+        elif isinstance(st, (ast.For, ast.AsyncFor)):
+            self._scan_expr(st.iter)
+            self._bind(st.target, self.is_tainted(st.iter))
+            for _ in range(2):
+                self._stmts(st.body)
+            self._stmts(st.orelse)
+        elif isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                self._scan_expr(item.context_expr)
+            self._stmts(st.body)
+        elif isinstance(st, ast.Try):
+            self._stmts(st.body)
+            for h in st.handlers:
+                self._stmts(h.body)
+            self._stmts(st.orelse)
+            self._stmts(st.finalbody)
+        elif isinstance(st, (ast.Return, ast.Expr)) and st.value is not None:
+            self._scan_expr(st.value)
+        elif isinstance(st, ast.Raise) and st.exc is not None:
+            self._scan_expr(st.exc)
+
+
+@rule(
+    id="JX001", severity="error",
+    scope="jit-reachable functions, whole package",
+    waiver="# trace-ok(",
+    doc=("Python if/while/bool()/float()/int()/.item() on a traced value "
+         "inside jit-reachable code"),
+)
+def check_jx001(mod: ModuleCtx) -> Iterator[Finding]:
+    project = getattr(mod, "project", None)
+    if project is None:
+        return
+    for qn, fi in mod.functions.items():
+        if not project.is_reachable(mod, qn):
+            continue
+        yield from _TaintPass(mod, fi.node).run()
+
+
+# ---------------------------------------------------------------------------
+# JX002 — retrace hazards
+# ---------------------------------------------------------------------------
+
+_JIT_CTORS = {"jax.jit", "jax.pjit", "jax.pmap", "jax.experimental.pjit.pjit"}
+
+
+@rule(
+    id="JX002", severity="error",
+    scope="whole package",
+    waiver="# retrace-ok(",
+    doc=("jax.jit/pjit/pmap constructed inside a loop, or over a fresh "
+         "lambda built per call — each construction is a new cache entry"),
+)
+def check_jx002(mod: ModuleCtx) -> Iterator[Finding]:
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        canon = mod.canonical(node.func) if isinstance(
+            node.func, (ast.Name, ast.Attribute)) else None
+        if canon not in _JIT_CTORS:
+            continue
+        if mod.in_loop(node):
+            yield Finding(
+                rule="JX002", path=mod.path, line=node.lineno,
+                message=("jit construction inside a loop — every iteration "
+                         "makes a fresh compilation-cache entry; hoist the "
+                         "jit out (or waive a build-once-per-bucket site "
+                         "with '# retrace-ok(<why>)')"),
+                snippet=_snippet(mod, node),
+            )
+        elif (node.args and isinstance(node.args[0], ast.Lambda)
+                and mod.enclosing_function(node) is not None):
+            yield Finding(
+                rule="JX002", path=mod.path, line=node.lineno,
+                message=("jit over a lambda built inside a function — a "
+                         "fresh lambda per call never hits the jit cache; "
+                         "name the function at module/build scope, or "
+                         "waive with '# retrace-ok(<why>)'"),
+                snippet=_snippet(mod, node),
+            )
+
+
+# ---------------------------------------------------------------------------
+# JX003 — unpinned dtypes in hot paths
+# ---------------------------------------------------------------------------
+
+
+@rule(
+    id="JX003", severity="error",
+    scope="env/ models/ agent/ serve/ sim/ layouts/ train/ loop/",
+    waiver="# dtype-ok(",
+    doc=("jnp/np arange|zeros|ones without an explicit dtype in a hot-path "
+         "dir — platform-default dtypes caused the sim/ i32-pin bug"),
+    dirs=JX003_DIRS,
+)
+def check_jx003(mod: ModuleCtx) -> Iterator[Finding]:
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        canon = mod.canonical(node.func) if isinstance(
+            node.func, (ast.Name, ast.Attribute)) else None
+        if canon is None:
+            continue
+        ns, _, fn = canon.rpartition(".")
+        if ns not in _ARRAY_NS or fn not in ("arange", "zeros", "ones"):
+            continue
+        if any(kw.arg == "dtype" for kw in node.keywords):
+            continue
+        # positional dtype: zeros/ones(shape, dtype); arange(a, b, step, dtype)
+        if fn in ("zeros", "ones") and len(node.args) >= 2:
+            continue
+        if fn == "arange" and len(node.args) >= 4:
+            continue
+        yield Finding(
+            rule="JX003", path=mod.path, line=node.lineno,
+            message=(f"{fn}() without an explicit dtype in a hot-path dir — "
+                     "pin it (i32 for indices, policy dtype for data), or "
+                     "waive with '# dtype-ok(<why>)'"),
+            snippet=_snippet(mod, node),
+        )
+
+
+# ---------------------------------------------------------------------------
+# JX004 — host sync inside the serving/training/sim hot loops
+# ---------------------------------------------------------------------------
+
+_HOST_SYNC_CALLS = {"numpy.asarray", "numpy.array", "jax.device_get"}
+
+
+def _is_hot_loop_fn(name: str) -> bool:
+    return name in _HOT_LOOP_NAMES or name.endswith("_tick") \
+        or name.endswith("_step")
+
+
+@rule(
+    id="JX004", severity="error",
+    scope="serve/ sim/ train/ loop/ — functions named tick/step/drain "
+          "(and *_tick/*_step)",
+    waiver="# host-sync-ok(",
+    doc=("np.asarray/.block_until_ready()/device_get/float(x[...]) inside "
+         "a hot loop body — each one is a device sync per tick"),
+    dirs=JX004_DIRS,
+)
+def check_jx004(mod: ModuleCtx) -> Iterator[Finding]:
+    project = getattr(mod, "project", None)
+    for qn, fi in mod.functions.items():
+        tail = qn.rsplit(".", 1)[-1]
+        if not _is_hot_loop_fn(tail):
+            continue
+        # a jitted train/sim step cannot host-sync (it would fail at trace
+        # time); JX004 is about the HOST side of the loop
+        if project is not None and project.is_reachable(mod, qn):
+            continue
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            canon = mod.canonical(node.func) if isinstance(
+                node.func, (ast.Name, ast.Attribute)) else None
+            hit = None
+            if canon in _HOST_SYNC_CALLS:
+                hit = canon
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "block_until_ready":
+                hit = ".block_until_ready()"
+            elif (isinstance(node.func, ast.Name)
+                  and node.func.id == "float" and node.args
+                  and isinstance(node.args[0], ast.Subscript)):
+                hit = "float(x[...]) read-back"
+            if hit:
+                yield Finding(
+                    rule="JX004", path=mod.path, line=node.lineno,
+                    message=(f"{hit} inside hot-loop function '{tail}' — "
+                             "one device sync per tick; batch the fetch or "
+                             "move it off the loop, or waive with "
+                             "'# host-sync-ok(<why>)'"),
+                    snippet=_snippet(mod, node),
+                )
+
+
+# ---------------------------------------------------------------------------
+# JX005 — nondeterminism outside injected clocks / seeded RNG
+# ---------------------------------------------------------------------------
+
+_WALL_CLOCKS = {"time.time", "time.monotonic", "time.perf_counter",
+                "time.process_time"}
+
+
+@rule(
+    id="JX005", severity="error",
+    scope="library code (cli/ exempt — the console owns wall time)",
+    waiver="# nondet-ok(",
+    doc=("wall-clock / global-RNG call in library code — inject clocks "
+         "(clock=time.monotonic param) and seed RNG; unseeded time/random "
+         "breaks replay and resume"),
+    exempt_dirs=("cli",),
+)
+def check_jx005(mod: ModuleCtx) -> Iterator[Finding]:
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        canon = mod.canonical(node.func) if isinstance(
+            node.func, (ast.Name, ast.Attribute)) else None
+        if canon is None:
+            continue
+        root = canon.split(".")[0]
+        msg = None
+        if canon in _WALL_CLOCKS and "time" in mod.aliases:
+            msg = (f"{canon}() call — inject the clock instead "
+                   "(`clock: Callable[[], float]` parameter, the health "
+                   "layer's convention)")
+        elif root == "random" and "random" in mod.aliases:
+            msg = (f"{canon}() — stdlib global RNG is unseeded "
+                   "nondeterminism; use np.random.default_rng(seed) or "
+                   "jax.random keys")
+        elif canon.startswith("numpy.random."):
+            fn = canon.rsplit(".", 1)[-1]
+            if fn == "default_rng":
+                if node.args or node.keywords:
+                    continue  # seeded — the sanctioned pattern
+                msg = ("np.random.default_rng() without a seed — "
+                       "nondeterministic; thread a seed in")
+            elif fn[:1].isupper() or fn == "Generator":
+                continue  # type reference, not a draw
+            else:
+                msg = (f"np.random.{fn}() — legacy global-state RNG; use "
+                       "np.random.default_rng(seed)")
+        if msg:
+            yield Finding(
+                rule="JX005", path=mod.path, line=node.lineno,
+                message=msg + ", or waive with '# nondet-ok(<why>)'",
+                snippet=_snippet(mod, node),
+            )

@@ -1,0 +1,120 @@
+"""AST re-implementations of the repo's original three lint rules.
+
+These replace the line regexes in the old `scripts/_lint_fallback.py`
+(MP001 / SL001 / OB001) with alias- and multi-line-aware AST checks:
+
+  * `jnp.zeros(\n    (n, n))` split across lines no longer escapes SL001
+    (the regex bug this engine was built to close);
+  * `import jax.numpy as jn; jn.float32` is still MP001 — any import
+    alias resolves through `ModuleCtx.canonical`;
+  * `z = jnp.zeros; z((n, n))` is still SL001 — simple value aliases are
+    one resolution hop in the alias map.
+
+Same waiver comments as before (`# fp32-island(`, `# dense-ok(`,
+`# print-ok(`), honored on ANY physical line the flagged call spans.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from multihop_offload_tpu.analysis.modinfo import ModuleCtx
+from multihop_offload_tpu.analysis.rules import Finding, rule
+
+_ARRAY_NS = ("numpy", "jax.numpy")
+
+# hot-path dirs match the original fallback rules exactly
+MP001_DIRS = ("env", "models", "agent", "serve", "sim")
+SL001_DIRS = ("env", "models", "serve", "sim")
+
+
+def _snippet(mod: ModuleCtx, node: ast.AST) -> str:
+    return mod.line(node.lineno).strip()
+
+
+@rule(
+    id="MP001", severity="error",
+    scope="env/ models/ agent/ serve/ sim/ (precision.py exempt)",
+    waiver="# fp32-island(",
+    doc=("hardcoded float32 in a hot-path module — dtypes flow from "
+         "precision.PrecisionPolicy"),
+    dirs=MP001_DIRS, exempt_files=("precision.py",),
+)
+def check_mp001(mod: ModuleCtx) -> Iterator[Finding]:
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Attribute):
+            continue
+        canon = mod.canonical(node)
+        if canon in ("numpy.float32", "jax.numpy.float32"):
+            yield Finding(
+                rule="MP001", path=mod.path, line=node.lineno,
+                message=("hardcoded float32 in hot path — take the dtype "
+                         "from precision.PrecisionPolicy, or waive with "
+                         "'# fp32-island(<why>)'"),
+                snippet=_snippet(mod, node),
+            )
+
+
+def _same_symbol_dims(elts) -> bool:
+    """First two tuple elements are the same Name/Attribute chain — the
+    (n, n) square-buffer signature the old regex looked for."""
+    if len(elts) < 2:
+        return False
+    a, b = elts[0], elts[1]
+    if not isinstance(a, (ast.Name, ast.Attribute)):
+        return False
+    return ast.dump(a) == ast.dump(b)
+
+
+@rule(
+    id="SL001", severity="error",
+    scope="env/ models/ serve/ sim/",
+    waiver="# dense-ok(",
+    doc=("dense square (N, N)-style materialization in a hot-path module — "
+         "instance structure flows through layouts/ edge lists"),
+    dirs=SL001_DIRS,
+)
+def check_sl001(mod: ModuleCtx) -> Iterator[Finding]:
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        canon = mod.canonical(node.func) if isinstance(
+            node.func, (ast.Name, ast.Attribute)) else None
+        if canon is None:
+            continue
+        ns, _, fn = canon.rpartition(".")
+        if ns not in _ARRAY_NS or fn not in ("zeros", "ones", "full", "empty"):
+            continue
+        shape = node.args[0]
+        if isinstance(shape, (ast.Tuple, ast.List)) \
+                and _same_symbol_dims(shape.elts):
+            yield Finding(
+                rule="SL001", path=mod.path, line=node.lineno,
+                message=("dense square materialization in hot path — route "
+                         "through the padded edge lists in layouts/, or "
+                         "waive with '# dense-ok(<why>)'"),
+                snippet=_snippet(mod, node),
+            )
+
+
+@rule(
+    id="OB001", severity="error",
+    scope="library code (cli/ and */cli.py exempt — printing is the "
+          "console's job)",
+    waiver="# print-ok(",
+    doc=("bare print() in library code — telemetry goes through the run "
+         "log / metric registry (obs/)"),
+    exempt_dirs=("cli",), exempt_files=("cli.py",),
+)
+def check_ob001(mod: ModuleCtx) -> Iterator[Finding]:
+    for node in ast.walk(mod.tree):
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and node.func.id == "print"):
+            yield Finding(
+                rule="OB001", path=mod.path, line=node.lineno,
+                message=("bare print() in library code — emit through the "
+                         "run log or metric registry (obs/), or waive with "
+                         "'# print-ok(<why>)'"),
+                snippet=_snippet(mod, node),
+            )

@@ -1,0 +1,190 @@
+"""Per-module symbol model for the analysis engine.
+
+`ModuleCtx` wraps one parsed source file with everything the checks need:
+
+  * the AST with parent links (`parent_of`) so checks can ask "is this
+    call inside a loop / a function / module scope";
+  * an import-alias map collected from EVERY `import`/`from ... import`
+    in the file (module scope AND function scope — lazy in-function jax
+    imports are this repo's idiom), so `canonical(node)` can resolve
+    `jnp.zeros`, `jn.zeros` (any alias), `from jax.numpy import zeros`,
+    and simple local aliases like `z = jnp.zeros` to one dotted name
+    (`jax.numpy.zeros`).  This is what makes the AST rules alias-aware
+    where the old line regexes only matched the literal spelling `jnp.`;
+  * a function index (`functions`): every `def`, keyed by dotted
+    qualname (`Class.method`, `outer.<locals>.inner` collapses to
+    `outer.inner`), used by the jit-reachability pass.
+
+Waiver handling note: checks report the node's `lineno`; the ENGINE
+scans `lineno..end_lineno` for the rule's waiver token, so a waiver
+comment on any physical line of a multi-line call is honored.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Tuple
+
+# canonical prefixes we normalize toward; anything else resolves to the
+# import target verbatim (e.g. multihop_offload_tpu.env.scheduling)
+_NUMPY_ALIASES = {"numpy": "numpy", "jax.numpy": "jax.numpy"}
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    """One function/method definition inside a module."""
+
+    qualname: str
+    node: ast.AST               # FunctionDef | AsyncFunctionDef | Lambda
+    lineno: int
+
+
+class ModuleCtx:
+    """Parsed module + symbol info (see module docstring)."""
+
+    def __init__(self, path: str, rel_parts: Tuple[str, ...], source: str,
+                 tree: ast.Module):
+        self.path = path
+        self.rel_parts = rel_parts          # path parts under the pkg root
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self._parents: Dict[int, ast.AST] = {}
+        self.aliases: Dict[str, str] = {}   # local name -> dotted target
+        self.functions: Dict[str, FuncInfo] = {}
+        self._index()
+
+    # ---- construction ------------------------------------------------------
+
+    def _index(self) -> None:
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[id(child)] = parent
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    bind = a.asname or a.name.split(".")[0]
+                    # `import jax.numpy as jnp` binds jnp -> jax.numpy;
+                    # bare `import jax.numpy` binds jax -> jax
+                    self.aliases[bind] = a.name if a.asname else bind
+            elif isinstance(node, ast.ImportFrom):
+                if node.module is None or node.level:
+                    continue  # relative imports stay package-internal
+                for a in node.names:
+                    bind = a.asname or a.name
+                    if bind != "*":
+                        self.aliases[bind] = f"{node.module}.{a.name}"
+        # simple value aliases: `z = jnp.zeros` (module or function scope)
+        # make the constructor rules alias-proof; one extra resolution hop
+        # only — chains of aliases are not followed.
+        for node in ast.walk(self.tree):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, (ast.Attribute, ast.Name))):
+                tgt = self._dotted(node.value)
+                if tgt:
+                    root = tgt.split(".", 1)[0]
+                    base = self.aliases.get(root)
+                    if base and root not in ("self", "cls"):
+                        resolved = tgt.replace(root, base, 1)
+                        if resolved.split(".", 1)[0] in ("numpy", "jax"):
+                            self.aliases.setdefault(
+                                node.targets[0].id, resolved)
+        self._index_functions(self.tree, prefix="")
+
+    def _index_functions(self, node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = f"{prefix}{child.name}"
+                self.functions[qn] = FuncInfo(qn, child, child.lineno)
+                self._index_functions(child, prefix=f"{qn}.")
+            elif isinstance(child, ast.ClassDef):
+                self._index_functions(child, prefix=f"{prefix}{child.name}.")
+            else:
+                self._index_functions(child, prefix)
+
+    # ---- queries -----------------------------------------------------------
+
+    def parent_of(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(id(node))
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self.parent_of(node)
+        while cur is not None:
+            yield cur
+            cur = self.parent_of(cur)
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        for a in self.ancestors(node):
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                return a
+        return None
+
+    def in_loop(self, node: ast.AST, stop_at_function: bool = True) -> bool:
+        """Is `node` lexically inside a for/while body?  With
+        `stop_at_function` the search stops at the nearest enclosing def:
+        a function defined in a loop is the *function's* problem only if
+        the call site is (JX002 handles the def-in-loop case itself)."""
+        for a in self.ancestors(node):
+            if isinstance(a, (ast.For, ast.While, ast.AsyncFor)):
+                return True
+            if stop_at_function and isinstance(
+                    a, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                return False
+        return False
+
+    def _dotted(self, node: ast.AST) -> Optional[str]:
+        """Raw dotted text of a Name/Attribute chain, no alias resolution."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+
+    def canonical(self, node: ast.AST) -> Optional[str]:
+        """Resolve a Name/Attribute chain through the import-alias map:
+        `jnp.zeros` -> `jax.numpy.zeros`, `scan` (from jax.lax import
+        scan) -> `jax.lax.scan`.  Unresolvable chains (locals, self.x)
+        return the raw dotted text — callers match on known prefixes, so
+        an unresolved local name simply never matches."""
+        dotted = self._dotted(node)
+        if dotted is None:
+            return None
+        root, _, rest = dotted.partition(".")
+        base = self.aliases.get(root)
+        if base is None:
+            return dotted
+        return f"{base}.{rest}" if rest else base
+
+    def line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def span_lines(self, node: ast.AST) -> range:
+        end = getattr(node, "end_lineno", None) or node.lineno
+        return range(node.lineno, end + 1)
+
+
+def parse_module(path: str, rel_parts: Tuple[str, ...],
+                 source: Optional[str] = None) -> Tuple[Optional[ModuleCtx],
+                                                        Optional["object"]]:
+    """Parse one file; on syntax error return (None, the E999 finding)."""
+    if source is None:
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        from multihop_offload_tpu.analysis.rules import Finding
+        return None, Finding(
+            rule="E999", path=path, line=e.lineno or 0,
+            message=f"syntax error: {e.msg}",
+            snippet=(e.text or "").strip(),
+        )
+    return ModuleCtx(path, rel_parts, source, tree), None

@@ -1,0 +1,210 @@
+"""Project-level jit-reachability: which functions run under a JAX trace.
+
+A function is a **jit root** when it is
+
+  * decorated with a jit-family transform (`@jax.jit`, `@partial(jax.jit,
+    static_argnames=...)`, `@jax.vmap`, ...), or
+  * passed by name to a jit-family call anywhere in the project
+    (`jax.jit(step)`, `lax.scan(body, ...)`, `jax.vmap(one)(...)`,
+    `shard_map(fwd, mesh=...)`).
+
+The **jit-reachable set** is the closure of the roots over the project
+call graph: anything a root calls (within the package) also executes
+under the trace.  Call edges are resolved conservatively-precise rather
+than by bare-name matching across the whole package:
+
+  * bare-name calls resolve to defs in the SAME module (including
+    enclosing/nested scopes), or to names imported `from <module> import
+    <fn>` (exact cross-module match via the alias map);
+  * dotted calls (`scheduling.mwis_activate(...)`) resolve through the
+    import-alias map to `<package>.<module>.<fn>` exact matches;
+  * `self.method(...)` resolves within the enclosing class only.
+
+Unresolvable calls (getattr dances, callables passed as values) simply
+add no edge — JX001 is a tripwire for the common spelling of the bug,
+not a soundness proof.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from multihop_offload_tpu.analysis.modinfo import ModuleCtx
+
+# canonical names whose first callable argument (or decorated function)
+# becomes traced code.  shard_map is matched by suffix: the repo routes it
+# through parallel.compat, so its canonical name is package-internal.
+JIT_FAMILY = {
+    "jax.jit", "jax.pjit", "jax.pmap", "jax.vmap", "jax.grad",
+    "jax.value_and_grad", "jax.checkpoint", "jax.remat",
+    "jax.lax.scan", "jax.lax.fori_loop", "jax.lax.while_loop",
+    "jax.lax.cond", "jax.lax.switch", "jax.lax.map", "jax.lax.associative_scan",
+    "jax.experimental.pjit.pjit",
+}
+
+
+def is_jit_family(canon: Optional[str]) -> bool:
+    if canon is None:
+        return False
+    return canon in JIT_FAMILY or canon == "shard_map" \
+        or canon.endswith(".shard_map")
+
+
+def _func_key(mod: ModuleCtx, qualname: str) -> Tuple[str, str]:
+    return (mod.path, qualname)
+
+
+class ProjectIndex:
+    """Function index + call graph + jit-reachable set over many modules."""
+
+    def __init__(self, modules: Iterable[ModuleCtx]):
+        self.modules: List[ModuleCtx] = list(modules)
+        # module path -> dotted module name (for cross-module resolution)
+        self._modname: Dict[str, str] = {}
+        for m in self.modules:
+            parts = list(m.rel_parts)
+            if parts and parts[-1].endswith(".py"):
+                parts[-1] = parts[-1][:-3]
+            if parts and parts[-1] == "__init__":
+                parts = parts[:-1]
+            self._modname[m.path] = ".".join(parts)
+        # dotted "modname.funcname" -> set of (path, qualname); tail names
+        # only (methods register under their bare name within the class)
+        self._by_dotted: Dict[str, Set[Tuple[str, str]]] = {}
+        for m in self.modules:
+            modname = self._modname[m.path]
+            for qn, fi in m.functions.items():
+                tail = qn.rsplit(".", 1)[-1]
+                self._by_dotted.setdefault(
+                    f"{modname}.{tail}", set()).add(_func_key(m, qn))
+        self.reachable: Set[Tuple[str, str]] = set()
+        self._compute()
+
+    # ---- resolution helpers ------------------------------------------------
+
+    def _resolve_local(self, mod: ModuleCtx, name: str,
+                       from_qualname: str) -> List[Tuple[str, str]]:
+        """A bare name used inside `from_qualname`: nearest enclosing-scope
+        def first (nested helpers), then any same-module def, then an
+        exact `from x import name` target."""
+        prefix = from_qualname
+        while prefix:
+            qn = f"{prefix}.{name}"
+            if qn in mod.functions:
+                return [_func_key(mod, qn)]
+            prefix = prefix.rsplit(".", 1)[0] if "." in prefix else ""
+        if name in mod.functions:
+            return [_func_key(mod, name)]
+        target = mod.aliases.get(name)
+        if target and target in self._by_dotted:
+            return sorted(self._by_dotted[target])
+        return []
+
+    def _resolve_call(self, mod: ModuleCtx, call: ast.Call,
+                      from_qualname: str) -> List[Tuple[str, str]]:
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            return self._resolve_local(mod, fn.id, from_qualname)
+        if isinstance(fn, ast.Attribute):
+            # self.method() -> same class
+            if (isinstance(fn.value, ast.Name) and fn.value.id in
+                    ("self", "cls") and "." in from_qualname):
+                cls = from_qualname.rsplit(".", 2)[0] \
+                    if from_qualname.count(".") >= 1 else ""
+                qn = f"{cls}.{fn.attr}" if cls else fn.attr
+                if qn in mod.functions:
+                    return [_func_key(mod, qn)]
+                return []
+            canon = mod.canonical(fn)
+            if canon and canon in self._by_dotted:
+                return sorted(self._by_dotted[canon])
+        return []
+
+    def _resolve_callable_arg(self, mod: ModuleCtx, node: ast.AST,
+                              from_qualname: str) -> List[Tuple[str, str]]:
+        """The function object handed to a jit-family call."""
+        if isinstance(node, ast.Name):
+            return self._resolve_local(mod, node.id, from_qualname)
+        if isinstance(node, ast.Attribute):
+            canon = mod.canonical(node)
+            if canon and canon in self._by_dotted:
+                return sorted(self._by_dotted[canon])
+        if isinstance(node, ast.Call):
+            # partial(fn, ...) / jit(fn) / shard_map(fn, mesh=...): unwrap
+            targets = []
+            for a in node.args[:1]:
+                targets += self._resolve_callable_arg(mod, a, from_qualname)
+            return targets
+        return []
+
+    # ---- the closure -------------------------------------------------------
+
+    def _owner_qualname(self, mod: ModuleCtx, node: ast.AST) -> str:
+        qn_by_node = getattr(mod, "_qn_by_node", None)
+        if qn_by_node is None:
+            qn_by_node = {id(fi.node): qn for qn, fi in mod.functions.items()}
+            mod._qn_by_node = qn_by_node
+        fn = mod.enclosing_function(node)
+        while fn is not None:
+            qn = qn_by_node.get(id(fn))
+            if qn is not None:
+                return qn
+            fn = mod.enclosing_function(fn)  # lambda owners: nearest def
+        return ""
+
+    def _compute(self) -> None:
+        roots: Set[Tuple[str, str]] = set()
+        edges: Dict[Tuple[str, str], Set[Tuple[str, str]]] = {}
+        for mod in self.modules:
+            # decorators
+            for qn, fi in mod.functions.items():
+                for dec in getattr(fi.node, "decorator_list", []):
+                    canon = mod.canonical(
+                        dec.func if isinstance(dec, ast.Call) else dec)
+                    if is_jit_family(canon):
+                        roots.add(_func_key(mod, qn))
+                    elif (isinstance(dec, ast.Call)
+                          and mod.canonical(dec.func) in
+                          ("functools.partial", "partial") and dec.args
+                          and is_jit_family(mod.canonical(dec.args[0]))):
+                        roots.add(_func_key(mod, qn))
+            # call sites: jit-family args become roots; plain calls, edges
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                owner = self._owner_qualname(mod, node)
+                canon = mod.canonical(node.func) \
+                    if isinstance(node.func,
+                                  (ast.Name, ast.Attribute)) else None
+                if is_jit_family(canon):
+                    for arg in node.args[:1]:
+                        roots.update(
+                            self._resolve_callable_arg(mod, arg, owner))
+                if owner:
+                    key = _func_key(mod, owner)
+                    for tgt in self._resolve_call(mod, node, owner):
+                        edges.setdefault(key, set()).add(tgt)
+        # nested defs of a reachable function are reachable too (closures
+        # built and called inside the traced body)
+        nested: Dict[Tuple[str, str], Set[Tuple[str, str]]] = {}
+        for mod in self.modules:
+            for qn in mod.functions:
+                if "." in qn:
+                    parent = qn.rsplit(".", 1)[0]
+                    if parent in mod.functions:
+                        nested.setdefault(
+                            _func_key(mod, parent), set()).add(
+                            _func_key(mod, qn))
+        frontier = list(roots)
+        seen = set(roots)
+        while frontier:
+            cur = frontier.pop()
+            for nxt in edges.get(cur, set()) | nested.get(cur, set()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    frontier.append(nxt)
+        self.reachable = seen
+
+    def is_reachable(self, mod: ModuleCtx, qualname: str) -> bool:
+        return _func_key(mod, qualname) in self.reachable

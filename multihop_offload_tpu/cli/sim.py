@@ -96,9 +96,10 @@ def run_scenarios(cfg: Config, steady: bool = True) -> dict:
     )
     lay = cfg.layout_policy
     keys = jax.random.split(jax.random.PRNGKey(cfg.seed), fleet)
-    bp = jax.jit(
-        lambda inst, jobs, key: baseline_policy(inst, jobs, key, layout=lay)
-    )
+    def _baseline_step(inst, jobs, key):
+        return baseline_policy(inst, jobs, key, layout=lay)
+
+    bp = jax.jit(_baseline_step)
     total_slots = cfg.sim_rounds * cfg.sim_slots
     fail_slot = total_slots // 2
     rng = np.random.default_rng(cfg.seed)

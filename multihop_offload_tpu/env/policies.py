@@ -129,7 +129,8 @@ def local_policy(
         seq_active=jnp.zeros((horizon, num_jobs), bool),
         inc_ext=jnp.zeros(
             (inst.num_pad_links + inst.num_pad_nodes, num_jobs), node_d.dtype
-        ).at[inst.num_pad_links + src32, jnp.arange(num_jobs)].add(
+        ).at[inst.num_pad_links + src32,
+             jnp.arange(num_jobs, dtype=jnp.int32)].add(
             jobs.mask.astype(node_d.dtype)
         ),
     )

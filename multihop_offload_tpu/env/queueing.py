@@ -257,7 +257,7 @@ def run_empirical(
             write,
             (jnp.zeros((num_links,), total.dtype),
              jnp.zeros((n,), total.dtype)),
-            jnp.arange(jobs.src.shape[0]),
+            jnp.arange(jobs.src.shape[0], dtype=jnp.int32),
         )
         link_written = (inc @ jnp.where(jmask, 1.0, 0.0)) > 0
         node_written = jnp.zeros((n,), bool).at[routes.dst].max(jmask)
@@ -266,13 +266,14 @@ def run_empirical(
     unit_matrix = jnp.zeros((n, n), total.dtype)  # dense-ok(train target: the (N, N) unit-delay matrix IS the supervised output)
     unit_matrix = unit_matrix.at[u, v].set(jnp.where(link_written, u_link, 0.0))
     unit_matrix = unit_matrix.at[v, u].max(jnp.where(link_written, u_link, 0.0))
-    unit_matrix = unit_matrix.at[jnp.arange(n), jnp.arange(n)].set(
+    iota = jnp.arange(n, dtype=jnp.int32)
+    unit_matrix = unit_matrix.at[iota, iota].set(
         jnp.where(node_written, u_node, 0.0)
     )
     unit_mask = jnp.zeros((n, n), bool)  # dense-ok(train target mask, same shape as the supervised unit matrix)
     unit_mask = unit_mask.at[u, v].max(link_written)
     unit_mask = unit_mask.at[v, u].max(link_written)
-    unit_mask = unit_mask.at[jnp.arange(n), jnp.arange(n)].max(node_written)
+    unit_mask = unit_mask.at[iota, iota].max(node_written)
 
     return EmpiricalDelays(
         job_total=total,

@@ -83,14 +83,16 @@ def trace_routes(
     # (reference `routes_np`, gnn_offloading_agent.py:310-331).
     inc = None
     if with_inc:
-        cols = jnp.broadcast_to(jnp.arange(num_jobs)[None, :], seq_slot.shape)
+        cols = jnp.broadcast_to(
+            jnp.arange(num_jobs, dtype=jnp.int32)[None, :], seq_slot.shape
+        )
         inc = jnp.zeros(
             (num_links + n, num_jobs), dtype=inst.link_rates.dtype
         ).at[seq_slot.reshape(-1), cols.reshape(-1)].add(
             seq_active.reshape(-1).astype(inst.link_rates.dtype)
         )
         pseudo = num_links + dst
-        inc = inc.at[pseudo, jnp.arange(num_jobs)].add(
+        inc = inc.at[pseudo, jnp.arange(num_jobs, dtype=jnp.int32)].add(
             jobs.mask.astype(inc.dtype)
         )
 

@@ -33,7 +33,7 @@ def local_greedy_mwis(
     """
     n = wts.shape[-1]
     remain0 = jnp.ones((n,), bool) if mask is None else mask.astype(bool)
-    idx = jnp.arange(n)
+    idx = jnp.arange(n, dtype=jnp.int32)
     adj_b = adj > 0
 
     def cond(state):

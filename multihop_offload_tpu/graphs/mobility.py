@@ -37,7 +37,7 @@ def random_walk(
     one is itself connected — a mobility trace should stall, not crash,
     on a hard slot.  Only an input that is ALREADY disconnected raises.
     """
-    rng = rng or np.random.default_rng()
+    rng = rng or np.random.default_rng()  # nondet-ok(explicit caller opt-in: no rng passed)
     n = pos.shape[0]
     if n == 0:
         return pos.copy(), np.zeros((0, 0), dtype=np.uint8)

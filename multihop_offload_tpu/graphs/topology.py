@@ -155,7 +155,7 @@ def sample_link_rates(
     Mirrors `links_init` (`offloading_v3.py:252-260`).  `rates` is a scalar or
     an (L,)-vector in canonical link order.
     """
-    rng = rng or np.random.default_rng()
+    rng = rng or np.random.default_rng()  # nondet-ok(explicit caller opt-in: no rng passed)
     rates = np.asarray(rates, dtype=np.float64)
     if rates.ndim == 1:
         assert rates.shape[0] == topo.num_links

@@ -71,7 +71,7 @@ def run_manifest(cfg=None, role: str = "") -> dict:
     man = {
         "event": "manifest",
         "schema_version": SCHEMA_VERSION,
-        "ts": time.time(),
+        "ts": time.time(),  # nondet-ok(manifest stamp: real wall time of the run)
         "role": role,
         "pid": os.getpid(),
         "git_sha": _git_sha(),
@@ -139,7 +139,8 @@ class RunLog:
         os.replace(self.path, f"{self.path}.{self._seq:04d}")
         self._seq += 1
         self._f = open(self.path, "w", buffering=1)
-        header = json.dumps({"event": "segment", "ts": time.time(),
+        header = json.dumps({"event": "segment",
+                             "ts": time.time(),  # nondet-ok(segment stamp)
                              "seq": self._seq}) + "\n"
         self._f.write(header)
         self._bytes = len(header)
@@ -156,7 +157,9 @@ class RunLog:
             self._bytes += len(line)
 
     def emit(self, event: str, **fields) -> None:
-        self._write({"event": event, "ts": time.time(), **fields})
+        self._write({"event": event,
+                     "ts": time.time(),  # nondet-ok(run-log events carry real wall time)
+                     **fields})
 
     # ---- typed helpers -----------------------------------------------------
 

@@ -71,14 +71,14 @@ def span(name: str, block: bool = False, emit: bool = False,
         "trace_id": stack[-1]["trace_id"] if stack else f"{sid:08x}",
     }
     stack.append(rec)
-    t0 = time.perf_counter()
+    t0 = time.perf_counter()  # nondet-ok(span duration is wall time by definition)
     try:
         with jax.profiler.TraceAnnotation(name):
             yield rec
     finally:
         if block:
             jax.effects_barrier()
-        dt = time.perf_counter() - t0
+        dt = time.perf_counter() - t0  # nondet-ok(span duration is wall time by definition)
         stack.pop()
         _registry().histogram(
             PHASE_METRIC, "host span / phase wall seconds"

@@ -101,7 +101,10 @@ class BucketExecutor:
 
                 return jax.vmap(one)(binst, bjobs, keys)
 
-            self._steps[b] = (jax.jit(gnn_step), jax.jit(baseline_step))
+            self._steps[b] = (
+                jax.jit(gnn_step),  # retrace-ok(one program per bucket, built once at construction)
+                jax.jit(baseline_step),  # retrace-ok(same: the loop IS the build)
+            )
 
     def run(self, bucket: int, binst, bjobs, keys, degraded: bool = False,
             request_ids=None):

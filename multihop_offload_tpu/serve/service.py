@@ -201,7 +201,9 @@ class OffloadService:
                 while len(keys) < self.slots:   # pad slots reuse the last key
                     keys.append(keys[-1])
                 out = self.executor.run(
-                    b, binst, bjobs, np.stack([np.asarray(k) for k in keys]),
+                    b, binst, bjobs,
+                    np.stack([np.asarray(k)  # host-sync-ok(PRNG keys are built host-side; one stack per batch)
+                              for k in keys]),
                     degraded=degraded, request_ids=ids,
                 )
                 t_done = self.clock() if now is None else now

@@ -72,7 +72,7 @@ def make_case(seed: int, topo, pad: PadSpec, num_jobs: int,
     rates = sample_link_rates(topo, 50.0, rng=rng)
     inst = build_instance(topo, roles, bws, rates, 1000.0, pad, dtype=dtype,
                           layout=lay)
-    mobile = np.setdiff1d(np.arange(n_nodes), servers)
+    mobile = np.setdiff1d(np.arange(n_nodes, dtype=np.int64), servers)
     srcs = rng.choice(mobile, size=min(num_jobs, mobile.size), replace=False)
     jrates = rng.uniform(0.5, 1.0, srcs.size)
     jobs = build_jobset(srcs, jrates, pad_jobs=pad.j, dtype=dtype,

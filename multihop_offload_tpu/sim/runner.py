@@ -112,7 +112,7 @@ def simulate(
     )
     xs = (
         jax.random.split(key, rounds),
-        jnp.arange(rounds) == 0,
+        jnp.arange(rounds, dtype=jnp.int32) == 0,
     )
     (st_f, _, routes_f), (ests, scheds) = jax.lax.scan(
         round_body, (state, state.generated, routes0), xs

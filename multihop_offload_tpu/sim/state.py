@@ -233,7 +233,7 @@ def migrate_sim_state(
             perm[i] = j
             perm[num_links + i] = num_links + j
     perm[2 * num_links:2 * num_links + n] = np.arange(
-        2 * num_links, 2 * num_links + n
+        2 * num_links, 2 * num_links + n, dtype=np.int64
     )
     keep = perm >= 0
     src = np.where(keep, perm, 0)
@@ -252,7 +252,7 @@ def migrate_sim_state(
     old_stream = np.asarray(state.buf_stream)
     dropped = np.asarray(state.dropped).astype(np.int64).copy()
     for q in np.flatnonzero(~claimed[: q1 - 1] & (old_count[: q1 - 1] > 0)):
-        idx = (old_head[q] + np.arange(old_count[q])) % c
+        idx = (old_head[q] + np.arange(old_count[q], dtype=np.int64)) % c
         np.add.at(dropped, old_stream[q, idx], 1)
 
     sched = np.asarray(state.sched_slots)

@@ -98,7 +98,8 @@ def plot_training_monitor(csv_path: str, out_dir: str = "fig",
     for method, grp in df.groupby(algo):
         grp = grp.sort_values("fid") if "fid" in grp.columns else grp
         roll = grp["tau"].rolling(window, min_periods=1).mean()
-        ax.plot(np.arange(len(roll)), roll, label=str(method))
+        ax.plot(np.arange(len(roll), dtype=np.int64), roll,
+                label=str(method))
     ax.set_xlabel("instances seen")
     ax.set_ylabel(f"tau (rolling {window})")
     ax.set_yscale("log")

@@ -59,7 +59,7 @@ def make_lineage(source: str, parent_step: Optional[int] = None,
 
     lin = {
         "source": source,
-        "ts": time.time(),
+        "ts": time.time(),  # nondet-ok(lineage stamp: when the checkpoint was written)
         "git_sha": obs_events._git_sha(),
         "config_hash": obs_events.config_hash(cfg) if cfg is not None else None,
         "parent_step": parent_step,

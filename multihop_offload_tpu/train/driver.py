@@ -44,6 +44,11 @@ from multihop_offload_tpu.train.data import DatasetCache, sample_jobsets
 from multihop_offload_tpu.train.metrics import instance_metrics
 from multihop_offload_tpu.train.tb_logging import ScalarLogger
 
+# host-side phase timing for the obs report (input-wait vs device
+# split); never feeds device math or decisions — nondet-ok(wall-time
+# measurement is the point; JX005 bans ad-hoc wall-clock reads in logic)
+_wall = time.time
+
 TRAIN_COLUMNS = [
     "fid", "filename", "seed", "num_nodes", "m", "num_mobile", "num_servers",
     "num_relays", "num_jobs", "n_instance", "method", "runtime", "gap_2_bl",
@@ -242,7 +247,9 @@ class _Harness:
                 g = jax.tree_util.tree_map(lambda x: x[i], outs.grads["params"])
                 return replay_remember(m, g, outs.loss_critic[i], outs.loss_mse[i]), None
 
-            mem, _ = jax.lax.scan(remember, mem, jnp.arange(keys.shape[0]))
+            mem, _ = jax.lax.scan(
+                remember, mem, jnp.arange(keys.shape[0], dtype=jnp.int32)
+            )
             return mem, outs.delays.job_total, outs.loss_critic, outs.loss_mse
 
         compat_diag = self.cfg.compat_diagonal_bug
@@ -599,7 +606,7 @@ class Trainer(_Harness):
             Consumes `self.rng` — the pipeline below preserves the exact
             draw order of the sequential loop (build fid, build fid+1, ...)
             so seeded runs stay bit-identical."""
-            t0 = time.time()
+            t0 = _wall()
             with span("train/build"):
                 rec = self.data.records[fid]
                 inst = to_device(self.data.instance(fid, self.rng))
@@ -609,7 +616,7 @@ class Trainer(_Harness):
                     dtype=self.precision.storage_dtype,
                     index_dtype=self.layout.index_dtype,
                 )
-            return (rec, inst, jobsets, counts), time.time() - t0
+            return (rec, inst, jobsets, counts), _wall() - t0
 
         for epoch in range(epochs if epochs is not None else cfg.epochs):
             order = self.rng.permutation(len(self.data))
@@ -623,7 +630,7 @@ class Trainer(_Harness):
             pf = _Prefetcher(order, _build_file, cfg.prefetch)
             for fid in order:
                 rec, inst, jobsets, counts = pf.current()
-                t0 = time.time()
+                t0 = _wall()
                 with span("train/step"):
                     if self.n_dp > 1:
                         # pad the episode batch to a device-divisible width;
@@ -632,7 +639,7 @@ class Trainer(_Harness):
                         b = cfg.num_instances
                         bp = -(-b // self.n_dp) * self.n_dp
                         jobsets_p = _pad_leading(jobsets, bp)
-                        valid = jnp.arange(bp) < b
+                        valid = jnp.arange(bp, dtype=jnp.int32) < b
                         self.memory, gnn_totals, loss_c, loss_m = self._gnn_train_step_dp(
                             self.variables, self.memory, inst, jobsets_p,
                             self.next_keys(bp), valid,
@@ -663,7 +670,7 @@ class Trainer(_Harness):
                 # device serialized (single-core CPU) the subtraction is
                 # exact; with true overlap and a build longer than the
                 # device step it underestimates (documented approximation).
-                wall = time.time() - t0
+                wall = _wall() - t0
                 runtime = max(wall - next_build_s, 0.0) / (4 * cfg.num_instances)
                 self.mem_count = min(
                     self.mem_count + cfg.num_instances, self.memory.loss_critic.shape[0]
@@ -774,7 +781,7 @@ class Evaluator(_Harness):
         paths so `file_batch>1` and `==1` realize identical workloads for
         the same seed.  Returns ((rec, inst, jobsets, counts), seconds)."""
         cfg = self.cfg
-        t0 = time.time()
+        t0 = _wall()
         with span("eval/build"):
             rec = self.data.records[fid]
             frng = self._file_rng(fid)
@@ -785,7 +792,7 @@ class Evaluator(_Harness):
                 dtype=self.precision.storage_dtype,
                 index_dtype=self.layout.index_dtype,
             )
-        return (rec, inst, jobsets, counts), time.time() - t0
+        return (rec, inst, jobsets, counts), _wall() - t0
 
     def run(self, files_limit: Optional[int] = None, out_dir: Optional[str] = None,
             verbose: bool = True, file_ids=None):
@@ -855,14 +862,14 @@ class Evaluator(_Harness):
             pf = _Prefetcher(fids, self._build_file, cfg.prefetch)
             for i, fid in enumerate(fids):
                 rec, inst, jobsets, counts = pf.current()
-                t0 = time.time()
+                t0 = _wall()
                 with span("eval/step"):
                     bl, loc, gnn = self._eval_methods(
                         self.variables, inst, jobsets, self._file_keys(fid)
                     )
                     next_build_s = pf.prefetch_next()
                     jax.block_until_ready(gnn)
-                wall = time.time() - t0
+                wall = _wall() - t0
                 runtime = max(wall - next_build_s, 0.0) / (3 * cfg.num_instances)
                 metrics = _method_metrics(
                     {"baseline": bl, "local": loc, "GNN": gnn},
@@ -910,7 +917,7 @@ class Evaluator(_Harness):
             file through the SHARED `_build_file` (one workload-draw
             definition across eval paths)."""
             _, chunk = bucket_chunk
-            t0 = time.time()
+            t0 = _wall()
             insts, jsets, cnts = [], [], []
             for fid in chunk:
                 (_, inst, js, counts), _ = self._build_file(fid)
@@ -921,7 +928,7 @@ class Evaluator(_Harness):
                 insts.append(insts[-1])
                 jsets.append(jsets[-1])
             return (stack_instances(insts), stack_instances(jsets), jsets,
-                    cnts), time.time() - t0
+                    cnts), _wall() - t0
 
         rows_by_fid = {}
         done = 0
@@ -933,14 +940,14 @@ class Evaluator(_Harness):
             # their rows are dropped, and no extra draws may occur)
             padded = list(chunk) + [chunk[-1]] * (self.eval_chunk - real)
             keys = jnp.stack([self._file_keys(f) for f in padded])
-            t0 = time.time()
+            t0 = _wall()
             with span("eval/step"):
                 bl, loc, gnn = self._eval_files_dp(
                     self.variables, binst, bjobs, keys
                 )
                 next_build_s = pf.prefetch_next()
                 jax.block_until_ready(gnn)
-            wall = time.time() - t0
+            wall = _wall() - t0
             # normalize by the full chunk width: pad slots run in parallel,
             # so per-eval cost is t/(3*I*eval_chunk); method compute only,
             # net of the overlapped successor build (see the sequential loop)

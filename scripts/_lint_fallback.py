@@ -1,267 +1,48 @@
-"""AST lint fallback for containers without ruff (see scripts/lint.sh).
+"""Thin compatibility shim over `multihop_offload_tpu.analysis` (mho-lint).
 
-Approximates the ruff rule classes pyproject.toml selects:
+The checks that used to live here as line regexes are now AST rules in
+the package's static-analysis engine (`multihop_offload_tpu/analysis/`,
+`mho-lint`) — alias- and multi-line-aware, with the same waiver comments
+(`# fp32-island(`, `# dense-ok(`, `# print-ok(`) plus the JAX-correctness
+rules JX001–JX005.  This shim only maps the historical flags so older
+scripts and muscle memory keep working:
 
-  E9   syntax / indentation errors (via `ast.parse`)
-  F401 unused imports (module scope, honoring `# noqa`, `__init__.py`
-       re-export hubs, and names listed in `__all__`)
-  F811 redefinition of an imported name by a later import
-  F841 locals assigned by a bare `name = ...` and never read are NOT
-       checked (too alias-happy without scope analysis) — ruff covers it
+    _lint_fallback.py [paths...]      -> mho-lint --select pyflakes [paths...]
+    _lint_fallback.py --precision ... -> mho-lint --select MP001 ...
+    _lint_fallback.py --layout ...    -> mho-lint --select SL001 ...
+    _lint_fallback.py --prints ...    -> mho-lint --select OB001 ...
 
-`--precision` runs the repo-specific mixed-precision rule instead (ruff has
-no equivalent, so `scripts/lint.sh` runs this mode on BOTH branches):
-hot-path modules (env/ models/ agent/ serve/ sim/) must not hardcode
-`jnp.float32` / `np.float32` — dtypes flow from `precision.PrecisionPolicy`.
-A deliberate fp32 island is waived per line with an explicit reason:
-
-    x = y.astype(jnp.float32)  # fp32-island(M/M/1 denominator 1-rho)
-
-`precision.py` itself (the policy definition) is exempt.
-
-`--prints` runs the observability rule (OB001, ruff's T20 class): library
-code under `multihop_offload_tpu/` must not write to stdout with a bare
-`print(` — telemetry goes through the run log / metric registry (`obs/`)
-so it survives redirection, rotation, and `mho-obs`.  CLI entry points
-(`multihop_offload_tpu/cli/`) are the console surface and are exempt.  A
-deliberate operator-facing print is waived per line with a reason:
-
-    print(f"loaded weights from {d}")  # print-ok(driver REPL feedback)
-
-`--layout` runs the sparse-layout rule (SL001, same shape as MP001):
-hot-path modules (env/ models/ serve/ sim/) must not materialize new dense
-square (N, N)-style arrays — instance structure flows through the padded
-edge lists in `layouts/` (ISSUE 7 / BENCH_r05: dense materializations are
-what pinned arithmetic intensity at 0.117).  A deliberate dense buffer
-(parity reference, train target, scan-carry shape) is waived per line:
-
-    unit_matrix = jnp.zeros((n, n), dt)  # dense-ok(train target)
-
-Zero third-party imports, stdlib-only, so the gate runs anywhere the repo
-does.  Exit status: 0 clean, 1 findings, 2 usage error.
+Exit status: 0 clean, 1 findings, 2 usage error — unchanged.  Still
+stdlib-only end to end; the engine imports neither jax nor ruff.
 """
 
 from __future__ import annotations
 
-import ast
 import os
-import re
 import sys
 
-PRECISION_HOT_DIRS = ("env", "models", "agent", "serve", "sim")
-_F32_LITERAL = re.compile(r"\b(?:jnp|np|numpy)\.float32\b")
-_WAIVER = "# fp32-island("
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-LAYOUT_HOT_DIRS = ("env", "models", "serve", "sim")
-# square dense constructor: both dims the same symbol, e.g. zeros((n, n))
-_SQUARE_DENSE = re.compile(
-    r"\b(?:jnp|np|numpy)\.(?:zeros|ones|full|empty)\(\s*"
-    r"\(\s*([A-Za-z_][\w.]*)\s*,\s*\1\s*[,)]"
-)
-_LAYOUT_WAIVER = "# dense-ok("
+from multihop_offload_tpu.analysis.cli import main as _engine_main  # noqa: E402
 
-# bare call only: `print(` not preceded by `.` (method) or a word char,
-# so `pprint(`, `self.print(` and `builtins.print(` don't match
-_PRINT_CALL = re.compile(r"(?<![\w.])print\s*\(")
-_PRINT_WAIVER = "# print-ok("
-PRINT_EXEMPT = os.path.join("multihop_offload_tpu", "cli") + os.sep
-
-
-def _py_files(roots):
-    for root in roots:
-        if os.path.isfile(root):
-            if root.endswith(".py"):
-                yield root
-            continue
-        for dirpath, dirnames, filenames in os.walk(root):
-            dirnames[:] = [d for d in dirnames
-                           if d not in ("__pycache__", ".git", ".ruff_cache")]
-            for fn in filenames:
-                if fn.endswith(".py"):
-                    yield os.path.join(dirpath, fn)
-
-
-def _noqa_lines(src: str):
-    return {i for i, line in enumerate(src.splitlines(), 1)
-            if "# noqa" in line}
-
-
-class _ImportVisitor(ast.NodeVisitor):
-    """Collect module-scope imported names and every referenced name."""
-
-    def __init__(self):
-        self.imports = {}   # name -> (lineno, display)
-        self.used = set()
-        self.redefs = []    # (lineno, name)
-
-    def _add(self, name: str, lineno: int, display: str):
-        if name == "*":
-            return
-        if name in self.imports:
-            self.redefs.append((lineno, name))
-        self.imports[name] = (lineno, display)
-
-    def visit_Import(self, node):
-        for a in node.names:
-            bind = a.asname or a.name.split(".")[0]
-            self._add(bind, node.lineno, a.name)
-
-    def visit_ImportFrom(self, node):
-        if node.module == "__future__":
-            return
-        for a in node.names:
-            bind = a.asname or a.name
-            self._add(bind, node.lineno, f"{node.module}.{a.name}")
-
-    def visit_Name(self, node):
-        if isinstance(node.ctx, ast.Load):
-            self.used.add(node.id)
-
-    def visit_Attribute(self, node):
-        self.generic_visit(node)
-
-
-def check_file(path: str):
-    with open(path, encoding="utf-8") as f:
-        src = f.read()
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        return [(e.lineno or 0, f"E999 syntax error: {e.msg}")]
-    findings = []
-    noqa = _noqa_lines(src)
-    is_init = os.path.basename(path) == "__init__.py"
-    v = _ImportVisitor()
-    # module-scope imports only: function-local imports are the repo's lazy
-    # jax-import idiom and are near-always used
-    for node in tree.body:
-        if isinstance(node, (ast.Import, ast.ImportFrom)):
-            v.visit(node)
-    v.used = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
-            v.used.add(node.id)
-    exported = set()
-    for node in tree.body:
-        if (isinstance(node, ast.Assign)
-                and any(isinstance(t, ast.Name) and t.id == "__all__"
-                        for t in node.targets)
-                and isinstance(node.value, (ast.List, ast.Tuple))):
-            exported = {e.value for e in node.value.elts
-                        if isinstance(e, ast.Constant)}
-    # names referenced inside docstring-driven doctests etc. are not seen;
-    # accept string-literal mentions as use (cheap, kills false positives)
-    literal_text = " ".join(
-        n.value for n in ast.walk(tree)
-        if isinstance(n, ast.Constant) and isinstance(n.value, str)
-    )
-    for name, (lineno, display) in v.imports.items():
-        if is_init or lineno in noqa or name in exported:
-            continue
-        if name in v.used or name in literal_text.split():
-            continue
-        if name.startswith("_"):
-            continue
-        findings.append((lineno, f"F401 unused import '{display}' as '{name}'"))
-    for lineno, name in v.redefs:
-        if lineno not in noqa:
-            findings.append((lineno, f"F811 import redefines '{name}'"))
-    return findings
-
-
-def check_precision_file(path: str):
-    """MP001: hardcoded float32 literal in a hot-path module (see module
-    docstring).  Waive a deliberate island with `# fp32-island(<why>)`."""
-    with open(path, encoding="utf-8") as f:
-        src = f.read()
-    findings = []
-    for lineno, line in enumerate(src.splitlines(), 1):
-        code = line.split("#", 1)[0]
-        if not _F32_LITERAL.search(code):
-            continue
-        if _WAIVER in line or "# noqa" in line:
-            continue
-        findings.append((lineno, (
-            "MP001 hardcoded float32 in hot path — take the dtype from "
-            "precision.PrecisionPolicy, or waive with '# fp32-island(<why>)'"
-        )))
-    return findings
-
-
-def check_layout_file(path: str):
-    """SL001: new dense square (N, N)-style materialization in a hot-path
-    module (see module docstring).  Waive a deliberate dense buffer with
-    `# dense-ok(<why>)`."""
-    with open(path, encoding="utf-8") as f:
-        src = f.read()
-    findings = []
-    for lineno, line in enumerate(src.splitlines(), 1):
-        code = line.split("#", 1)[0]
-        if not _SQUARE_DENSE.search(code):
-            continue
-        if _LAYOUT_WAIVER in line or "# noqa" in line:
-            continue
-        findings.append((lineno, (
-            "SL001 dense square materialization in hot path — route through "
-            "the padded edge lists in layouts/, or waive with "
-            "'# dense-ok(<why>)'"
-        )))
-    return findings
-
-
-def check_prints_file(path: str):
-    """OB001: bare `print(` in library code (see module docstring) — obs/
-    owns the telemetry surface.  Waive with `# print-ok(<why>)`."""
-    with open(path, encoding="utf-8") as f:
-        src = f.read()
-    findings = []
-    for lineno, line in enumerate(src.splitlines(), 1):
-        code = line.split("#", 1)[0]
-        if not _PRINT_CALL.search(code):
-            continue
-        if _PRINT_WAIVER in line or "# noqa" in line:
-            continue
-        findings.append((lineno, (
-            "OB001 bare print() in library code — emit through the run log "
-            "or metric registry (obs/), or waive with '# print-ok(<why>)'"
-        )))
-    return findings
-
-
-def precision_roots(pkg="multihop_offload_tpu"):
-    return [os.path.join(pkg, d) for d in PRECISION_HOT_DIRS]
-
-
-def layout_roots(pkg="multihop_offload_tpu"):
-    return [os.path.join(pkg, d) for d in LAYOUT_HOT_DIRS]
+_LEGACY_FLAGS = {
+    "--precision": "MP001",
+    "--layout": "SL001",
+    "--prints": "OB001",
+}
 
 
 def main(argv):
-    check = check_file
-    if argv and argv[0] == "--precision":
-        check = check_precision_file
-        argv = argv[1:] or precision_roots()
-    elif argv and argv[0] == "--layout":
-        check = check_layout_file
-        argv = argv[1:] or layout_roots()
-    elif argv and argv[0] == "--prints":
-        check = check_prints_file
-        argv = argv[1:] or ["multihop_offload_tpu"]
-    roots = argv or ["multihop_offload_tpu"]
-    total = 0
-    for path in sorted(_py_files(roots)):
-        if check is check_precision_file and \
-                os.path.basename(path) == "precision.py":
-            continue
-        if check is check_prints_file and PRINT_EXEMPT in path:
-            continue
-        for lineno, msg in sorted(check(path)):
-            print(f"{path}:{lineno}: {msg}")
-            total += 1
-    if total:
-        print(f"{total} finding(s)", file=sys.stderr)
-        return 1
-    return 0
+    if argv and argv[0] in _LEGACY_FLAGS:
+        select = _LEGACY_FLAGS[argv[0]]
+        paths = argv[1:] or ["multihop_offload_tpu"]
+    elif argv and argv[0].startswith("--"):
+        print(f"usage error: unknown flag {argv[0]}", file=sys.stderr)
+        return 2
+    else:
+        select = "pyflakes"
+        paths = argv or ["multihop_offload_tpu"]
+    return _engine_main(["--select", select, *paths])
 
 
 if __name__ == "__main__":

@@ -3,18 +3,23 @@
 #
 #   bash scripts/lint.sh
 #
-# Prefers ruff (configured in pyproject.toml [tool.ruff]); when ruff is not
-# installed (this container ships none of ruff/flake8/pyflakes), falls back
-# to scripts/_lint_fallback.py, an AST checker approximating the same rule
-# classes (syntax errors, unused imports, undefined-name smells).  The
-# mixed-precision rule (MP001: no hardcoded float32 in hot-path modules —
-# waive fp32 islands with `# fp32-island(<why>)`) and the sparse-layout
-# rule (SL001: no new dense (N, N) materializations in hot-path modules —
-# waive with `# dense-ok(<why>)`) have no ruff equivalent and run on BOTH
-# branches.  The observability rule (OB001: no bare print() in library
-# code — telemetry goes through obs/; waive with `# print-ok(<why>)`) maps
-# to ruff's T20 class on the ruff branch and runs via the fallback
-# checker otherwise.  Exit 0 = clean.
+# Two layers on BOTH branches:
+#
+#   1. generic Python hygiene — ruff (pyproject [tool.ruff]: E4/E7/E9, F,
+#      T20) when installed; otherwise the engine's ruff-approximation set
+#      (`mho-lint --select pyflakes`: E999/F401/F811) over the package,
+#      tests, scripts and bench.py;
+#   2. the repo-specific JAX-aware rules — `mho-lint` (the AST engine in
+#      multihop_offload_tpu/analysis/): JX001 trace-safety, JX002 retrace
+#      hazards, JX003 dtype pinning, JX004 hot-loop host sync, JX005
+#      nondeterminism, plus MP001 (precision), SL001 (layout), OB001
+#      (prints) — the three rules the old regex fallback carried, now
+#      alias- and multi-line-aware.  Waive deliberate sites per line with
+#      the rule's token (see `mho-lint --list-rules` or
+#      docs/OPERATIONS.md "Static analysis").
+#
+# scripts/_lint_fallback.py remains as a flag-compatible shim over the
+# engine.  Exit 0 = clean.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -23,22 +28,10 @@ if command -v ruff >/dev/null 2>&1; then
 elif python -c "import ruff" >/dev/null 2>&1; then
     python -m ruff check .
 else
-    echo "lint.sh: ruff not installed; using AST fallback checker" >&2
-    python scripts/_lint_fallback.py \
+    echo "lint.sh: ruff not installed; using mho-lint pyflakes set" >&2
+    python -m multihop_offload_tpu.analysis.cli --select pyflakes \
         multihop_offload_tpu tests scripts bench.py
 fi
 
-# repo-specific: hot paths must take dtypes from precision.PrecisionPolicy
-python scripts/_lint_fallback.py --precision
-
-# repo-specific: no new dense square (N, N) materializations in hot paths —
-# instance structure flows through layouts/ edge lists; waive deliberate
-# dense buffers with `# dense-ok(<why>)` (SL001)
-python scripts/_lint_fallback.py --layout
-
-# library code must not print to stdout — the run log / registry is the
-# telemetry surface; CLI entry points exempt, waive with
-# `# print-ok(<why>)` (OB001).  The ruff branch enforces the same class
-# via T20 + per-file-ignores in pyproject.toml; the fallback rule is
-# authoritative in this container.
-exec python scripts/_lint_fallback.py --prints
+# repo-specific JAX-aware rules (both branches — ruff has no equivalent)
+exec python -m multihop_offload_tpu.analysis.cli

@@ -4,18 +4,22 @@
 #   bash scripts/smoke.sh
 #
 # Chains (each must pass; total budget well under 90s on a CPU host):
-#   1. bash scripts/lint.sh          — ruff (or the stdlib AST fallback)
-#      plus the repo's MP001 mixed-precision and SL001 layout rules;
-#   2. mho-sim --smoke               — tiny simulator fleet: exact packet
+#   1. bash scripts/lint.sh          — ruff (or the engine's pyflakes set)
+#      plus the repo's JAX-aware rules (JX001-JX005, MP001, SL001, OB001);
+#   2. mho-lint --json               — the static-analysis engine alone,
+#      proving the JSON surface and the seeded-violation fixture dir
+#      (every rule must fire there — a rule that can't detect its target
+#      pattern is a dead gate);
+#   3. mho-sim --smoke               — tiny simulator fleet: exact packet
 #      conservation + a link-failure round;
-#   3. mho-sim --smoke --layout sparse — the same fleet on the padded-COO
+#   4. mho-sim --smoke --layout sparse — the same fleet on the padded-COO
 #      sparse instance layout (edge-list propagate, gathered delay math,
 #      int16 indices) — proves the layout knob end to end;
-#   4. mho-loop --smoke              — the continual-learning flywheel end
+#   5. mho-loop --smoke              — the continual-learning flywheel end
 #      to end: capture -> refit -> sim-gated A/B -> promote through
 #      hot-reload (zero unexpected retraces) -> injected regression ->
 #      automatic rollback; writes benchmarks/loop_smoke.json;
-#   5. mho-health --smoke            — the health subsystem's closed-loop
+#   6. mho-health --smoke            — the health subsystem's closed-loop
 #      breach drill: injected latency/overload burst -> SLO alert fires ->
 #      flight-recorder bundle dumps -> recovery resolves the alert ->
 #      drift detectors trip -> drift-triggered capture -> refit ->
@@ -29,19 +33,34 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
-echo "== [1/5] lint =="
+echo "== [1/6] lint =="
 bash scripts/lint.sh
 
-echo "== [2/5] mho-sim --smoke =="
+echo "== [2/6] mho-lint (engine: clean repo + every rule fires on seeds) =="
+python -m multihop_offload_tpu.analysis.cli --json >/dev/null
+python - <<'EOF'
+import json, subprocess, sys
+out = subprocess.run(
+    [sys.executable, "-m", "multihop_offload_tpu.analysis.cli", "--json",
+     "tests/fixtures/analysis_seeded"], capture_output=True, text=True)
+fired = {f["rule"] for f in json.loads(out.stdout)["findings"]}
+need = {"JX001", "JX002", "JX003", "JX004", "JX005",
+        "MP001", "SL001", "OB001"}
+missing = sorted(need - fired)
+assert not missing, f"rules silent on their seeded violations: {missing}"
+print(f"mho-lint: all {len(need)} repo rules fire on the seeded fixtures")
+EOF
+
+echo "== [3/6] mho-sim --smoke =="
 python -m multihop_offload_tpu.cli.sim --smoke
 
-echo "== [3/5] mho-sim --smoke --layout sparse =="
+echo "== [4/6] mho-sim --smoke --layout sparse =="
 python -m multihop_offload_tpu.cli.sim --smoke --layout sparse
 
-echo "== [4/5] mho-loop --smoke =="
+echo "== [5/6] mho-loop --smoke =="
 python -m multihop_offload_tpu.cli.loop --smoke
 
-echo "== [5/5] mho-health --smoke =="
+echo "== [6/6] mho-health --smoke =="
 python -m multihop_offload_tpu.cli.health --smoke
 
 echo "smoke: all green"

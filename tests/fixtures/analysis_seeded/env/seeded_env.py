@@ -1,0 +1,39 @@
+"""Seeded violations: MP001, SL001 (multi-line!), JX001, JX002, JX003.
+
+The SL001 site is split across lines exactly the way the old
+`_SQUARE_DENSE` regex could not see (tests/test_analysis.py reproduces
+the miss against the historical pattern).
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def hardcoded_dtype(x):
+    return x.astype(jnp.float32)  # MP001: hardcoded float32 in hot dir
+
+
+def dense_square(n):
+    return jnp.zeros(
+        (n, n)  # SL001: dense (N, N) — and JX003: no dtype — multi-line
+    )
+
+
+def unpinned_iota(n):
+    return jnp.arange(n)  # JX003: arange without dtype
+
+
+@jax.jit
+def traced_branch(x):
+    s = jnp.sum(x)
+    if s > 0:  # JX001: Python `if` on a traced value
+        return s
+    return -s
+
+
+def retrace_hazard(batches):
+    outs = []
+    for b in batches:
+        f = jax.jit(lambda v: v * 2)  # JX002: jit built per iteration
+        outs.append(f(b))
+    return outs

@@ -1,0 +1,13 @@
+"""Seeded violations: OB001 (library print) and JX005 (nondeterminism)."""
+
+import time
+
+import numpy as np
+
+
+def noisy_telemetry(value):
+    print(f"value={value}")  # OB001: bare print in library code
+    stamp = time.time()  # JX005: wall clock without an injected clock
+    jitter = np.random.rand()  # JX005: legacy global-state RNG
+    rng = np.random.default_rng()  # JX005: unseeded generator
+    return stamp + jitter + rng.random()

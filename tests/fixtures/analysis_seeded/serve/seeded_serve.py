@@ -1,0 +1,14 @@
+"""Seeded violation: JX004 (host sync inside a hot-loop `tick`)."""
+
+import numpy as np
+
+
+class MiniService:
+    def __init__(self, device_out):
+        self.device_out = device_out
+
+    def tick(self):
+        # JX004: one device sync per tick
+        host = np.asarray(self.device_out)
+        self.device_out.block_until_ready()  # JX004 again
+        return float(host[0])

@@ -1,0 +1,414 @@
+"""mho-lint engine tests: per-rule TP / waived / false-positive guard,
+the SL001 multi-line regression the old regex missed, jit-reachability,
+the baseline workflow, the CLI surfaces, and the two repo-level smokes
+(clean repo, every rule fires on the seeded fixture dir).
+
+Pure stdlib under test — none of this imports jax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+import textwrap
+
+from multihop_offload_tpu.analysis import run_analysis, write_baseline
+from multihop_offload_tpu.analysis.cli import main as lint_main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SEEDED = os.path.join(REPO, "tests", "fixtures", "analysis_seeded")
+ALL_REPO_RULES = {"JX001", "JX002", "JX003", "JX004", "JX005",
+                  "MP001", "SL001", "OB001"}
+
+
+def run_on(tmp_path, files, select=None, baseline=None):
+    """Write {relpath: source} under tmp_path and run the engine on it."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return run_analysis([str(tmp_path)], select=select, baseline=baseline)
+
+
+def rules_hit(report):
+    return {f.rule for f in report.findings}
+
+
+# ---------------------------------------------------------------------------
+# per-rule: true positive / waived / false-positive guard
+# ---------------------------------------------------------------------------
+
+
+def test_mp001_tp_waived_and_alias_aware(tmp_path):
+    rep = run_on(tmp_path, {"env/m.py": """\
+        import jax.numpy as weird_alias
+
+        def tp(x):
+            return x.astype(weird_alias.float32)
+
+        def waived(x):
+            return x.astype(weird_alias.float32)  # fp32-island(test)
+    """})
+    mp = [f for f in rep.findings if f.rule == "MP001"]
+    assert len(mp) == 1 and mp[0].line == 4  # the alias still resolves
+    assert len([f for f in rep.waived if f.rule == "MP001"]) == 1
+
+
+def test_mp001_not_outside_hot_dirs(tmp_path):
+    rep = run_on(tmp_path, {"utils/m.py": """\
+        import jax.numpy as jnp
+
+        def fine(x):
+            return x.astype(jnp.float32)
+    """})
+    assert "MP001" not in rules_hit(rep)
+
+
+_OLD_SQUARE_DENSE = re.compile(  # the historical regex, verbatim
+    r"\b(?:jnp|np|numpy)\.(?:zeros|ones|full|empty)\(\s*"
+    r"\(\s*([A-Za-z_][\w.]*)\s*,\s*\1\s*[,)]"
+)
+
+_MULTILINE_DENSE = """\
+import jax.numpy as jnp
+
+def build(n, dt):
+    return jnp.zeros(
+        (n, n), dt
+    )
+"""
+
+
+def test_sl001_multiline_regression_old_regex_missed_it(tmp_path):
+    # the escape: no single LINE matches the old regex...
+    assert not any(_OLD_SQUARE_DENSE.search(line)
+                   for line in _MULTILINE_DENSE.splitlines())
+    # ...but the AST rule sees the call whole
+    rep = run_on(tmp_path, {"env/m.py": _MULTILINE_DENSE})
+    sl = [f for f in rep.findings if f.rule == "SL001"]
+    assert len(sl) == 1 and sl[0].line == 4
+
+
+def test_sl001_waiver_on_any_physical_line_of_the_call(tmp_path):
+    rep = run_on(tmp_path, {"env/m.py": """\
+        import jax.numpy as jnp
+
+        def build(n, dt):
+            return jnp.zeros(
+                (n, n), dt  # dense-ok(test target)
+            )
+    """})
+    assert "SL001" not in rules_hit(rep)
+    assert len([f for f in rep.waived if f.rule == "SL001"]) == 1
+
+
+def test_sl001_fp_guards_rectangular_and_value_alias(tmp_path):
+    rep = run_on(tmp_path, {"env/m.py": """\
+        import jax.numpy as jnp
+
+        def fine(n, m, dt):
+            return jnp.zeros((n, m), dt)  # rectangular: not flagged
+
+        def aliased(n, dt):
+            z = jnp.zeros
+            return z((n, n), dt)  # value alias: STILL flagged
+    """})
+    sl = [f for f in rep.findings if f.rule == "SL001"]
+    assert len(sl) == 1 and sl[0].line == 8
+
+
+def test_ob001_tp_waived_and_pprint_guard(tmp_path):
+    rep = run_on(tmp_path, {"loop/m.py": """\
+        from pprint import pprint
+
+        def report(x):
+            print(x)
+            print(x)  # print-ok(operator feedback)
+            pprint(x)
+            x.print()
+    """})
+    ob = [f for f in rep.findings if f.rule == "OB001"]
+    assert len(ob) == 1 and ob[0].line == 4  # pprint/.print() untouched
+    assert len([f for f in rep.waived if f.rule == "OB001"]) == 1
+
+
+def test_ob001_exempts_cli(tmp_path):
+    rep = run_on(tmp_path, {"cli/m.py": "print('console surface')\n"})
+    assert "OB001" not in rules_hit(rep)
+
+
+def test_jx001_tp_waived_and_shadow_guard(tmp_path):
+    rep = run_on(tmp_path, {"env/m.py": """\
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def tp(x):
+            s = jnp.sum(x)
+            if s > 0:
+                return s
+            return -s
+
+        @jax.jit
+        def waived(x):
+            s = jnp.sum(x)
+            if s > 0:  # trace-ok(test)
+                return s
+            return -s
+
+        @jax.jit
+        def shadowed(x):
+            s = jnp.sum(x)
+            s = 3  # traced name rebound to a Python int
+            if s > 0:
+                return x
+            return -x
+
+        def host_helper(flag):
+            # NOT jit-reachable: plain Python branching is fine here
+            if flag > 0:
+                return 1
+            return 0
+    """})
+    jx = [f for f in rep.findings if f.rule == "JX001"]
+    assert len(jx) == 1 and jx[0].line == 7
+    assert len([f for f in rep.waived if f.rule == "JX001"]) == 1
+
+
+def test_jx001_static_shape_attrs_not_tainted(tmp_path):
+    rep = run_on(tmp_path, {"env/m.py": """\
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def fine(x):
+            y = jnp.abs(x)
+            if y.ndim == 2:          # static at trace time
+                return y[: y.shape[0] // 2]
+            return y
+    """})
+    assert "JX001" not in rules_hit(rep)
+
+
+def test_jx001_reaches_through_package_calls(tmp_path):
+    rep = run_on(tmp_path, {"env/m.py": """\
+        import jax
+        import jax.numpy as jnp
+
+        def helper(x):
+            s = jnp.max(x)
+            return float(s)  # concretization, two hops below the jit
+
+        def entry(x):
+            return helper(x) + 1
+
+        wrapped = jax.jit(entry)
+    """})
+    jx = [f for f in rep.findings if f.rule == "JX001"]
+    assert len(jx) == 1 and jx[0].line == 6
+
+
+def test_jx002_tp_waived_and_module_scope_guard(tmp_path):
+    rep = run_on(tmp_path, {"serve/m.py": """\
+        import jax
+
+        def per_batch(batches):
+            for b in batches:
+                f = jax.jit(lambda v: v * 2)
+                yield f(b)
+
+        def per_bucket(steps):
+            out = []
+            for s in steps:
+                out.append(jax.jit(s))  # retrace-ok(build loop)
+            return out
+
+        def fine(step):
+            return jax.jit(step)  # once, outside any loop
+
+        _module_level = jax.jit(lambda v: v + 1)  # built once at import
+    """})
+    jx = [f for f in rep.findings if f.rule == "JX002"]
+    assert len(jx) == 1 and jx[0].line == 5
+    assert len([f for f in rep.waived if f.rule == "JX002"]) == 1
+
+
+def test_jx003_tp_waived_and_explicit_dtype_guard(tmp_path):
+    rep = run_on(tmp_path, {"sim/m.py": """\
+        import jax.numpy as jnp
+
+        def tp(n):
+            return jnp.arange(n)
+
+        def waived(n):
+            return jnp.arange(n)  # dtype-ok(test)
+
+        def fine(n):
+            return jnp.arange(n, dtype=jnp.int32) + jnp.zeros((n,), jnp.float16)
+    """})
+    jx = [f for f in rep.findings if f.rule == "JX003"]
+    assert len(jx) == 1 and jx[0].line == 4
+    assert len([f for f in rep.waived if f.rule == "JX003"]) == 1
+
+
+def test_jx004_tp_waived_and_non_hot_function_guard(tmp_path):
+    rep = run_on(tmp_path, {"serve/m.py": """\
+        import numpy as np
+
+        class S:
+            def tick(self, out):
+                a = np.asarray(out)
+                b = np.asarray(out)  # host-sync-ok(test)
+                return a, b
+
+            def build(self, out):
+                return np.asarray(out)  # not a hot-loop function
+    """})
+    jx = [f for f in rep.findings if f.rule == "JX004"]
+    assert len(jx) == 1 and jx[0].line == 5
+    assert len([f for f in rep.waived if f.rule == "JX004"]) == 1
+
+
+def test_jx004_skips_jitted_steps(tmp_path):
+    # a jitted *_step cannot host-sync (trace-time failure) — the rule is
+    # about the HOST loop, so jit-reachable defs are excluded
+    rep = run_on(tmp_path, {"sim/m.py": """\
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def sim_step(x):
+            return np.asarray(x)  # would fail at trace time anyway
+    """})
+    assert "JX004" not in rules_hit(rep)
+
+
+def test_jx005_tp_waived_and_seeded_rng_guard(tmp_path):
+    rep = run_on(tmp_path, {"loop/m.py": """\
+        import time
+
+        import numpy as np
+
+        def tp():
+            return time.time()
+
+        def waived():
+            return time.monotonic()  # nondet-ok(test)
+
+        def fine(seed, clock=time.monotonic):
+            rng = np.random.default_rng(seed)  # seeded: sanctioned
+            return rng.random() + clock()      # injected clock: sanctioned
+    """})
+    jx = [f for f in rep.findings if f.rule == "JX005"]
+    assert len(jx) == 1 and jx[0].line == 6
+    assert len([f for f in rep.waived if f.rule == "JX005"]) == 1
+
+
+def test_jx005_exempts_cli(tmp_path):
+    rep = run_on(tmp_path, {"cli/m.py": """\
+        import time
+
+        def main():
+            return time.time()
+    """})
+    assert "JX005" not in rules_hit(rep)
+
+
+# ---------------------------------------------------------------------------
+# pyflakes set / syntax errors
+# ---------------------------------------------------------------------------
+
+
+def test_pyflakes_unused_import_and_syntax_error(tmp_path):
+    rep = run_on(tmp_path, {
+        "a.py": "import os\nimport sys\n\nprint(sys.argv)\n",
+        "b.py": "def broken(:\n    pass\n",
+    }, select="pyflakes")
+    assert {f.rule for f in rep.findings} == {"F401", "E999"}
+    f401 = [f for f in rep.findings if f.rule == "F401"]
+    assert "os" in f401[0].message
+
+
+# ---------------------------------------------------------------------------
+# baseline workflow
+# ---------------------------------------------------------------------------
+
+
+def test_baseline_suppresses_then_resurfaces_on_change(tmp_path):
+    files = {"env/m.py": """\
+        import jax.numpy as jnp
+
+        def tp(n):
+            return jnp.arange(n)
+    """}
+    rep = run_on(tmp_path, files)
+    assert rules_hit(rep) == {"JX003"}
+    bl = tmp_path / "baseline.json"
+    write_baseline(str(bl), rep.findings)
+
+    rep2 = run_analysis([str(tmp_path)], baseline=str(bl))
+    assert not rep2.findings and len(rep2.suppressed) == 1
+
+    # edit the flagged line: the suppression no longer matches
+    p = tmp_path / "env" / "m.py"
+    p.write_text(p.read_text().replace("jnp.arange(n)", "jnp.arange(2 * n)"))
+    rep3 = run_analysis([str(tmp_path)], baseline=str(bl))
+    assert rules_hit(rep3) == {"JX003"} and not rep3.suppressed
+
+
+# ---------------------------------------------------------------------------
+# repo-level smokes + CLI surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_repo_is_clean_under_the_engine():
+    """mho-lint exits 0 on the repo itself (repo rules, default scope)."""
+    rc = lint_main([os.path.join(REPO, "multihop_offload_tpu")])
+    assert rc == 0
+
+
+def test_seeded_fixture_dir_fires_every_rule():
+    out = subprocess.run(
+        [sys.executable, "-m", "multihop_offload_tpu.analysis.cli",
+         "--json", SEEDED],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert out.returncode == 1, out.stderr
+    fired = {f["rule"] for f in json.loads(out.stdout)["findings"]}
+    assert ALL_REPO_RULES <= fired, sorted(ALL_REPO_RULES - fired)
+
+
+def test_cli_json_report_and_exit_codes(tmp_path):
+    (tmp_path / "clean.py").write_text("X = 1\n")
+    report_file = tmp_path / "report.json"
+    rc = lint_main(["--json", "--report", str(report_file), str(tmp_path)])
+    assert rc == 0
+    data = json.loads(report_file.read_text())
+    assert data["tool"] == "mho-lint" and data["files_scanned"] == 1
+    assert set(data["rules"]) == ALL_REPO_RULES
+    assert lint_main(["--select", "NOPE", str(tmp_path)]) == 2
+
+
+def test_shim_maps_legacy_flags(tmp_path):
+    shim = os.path.join(REPO, "scripts", "_lint_fallback.py")
+    env = dict(os.environ, PYTHONPATH=REPO)
+    clean = tmp_path / "clean.py"
+    clean.write_text("X = 1\n")
+    noisy = tmp_path / "noisy.py"
+    noisy.write_text("print(1)\n")
+
+    def shim_rc(*argv):
+        out = subprocess.run([sys.executable, shim, *argv],
+                             capture_output=True, text=True, cwd=REPO, env=env)
+        return out.returncode, out.stdout + out.stderr
+
+    for flags in (["--precision", str(clean)], ["--layout", str(clean)],
+                  ["--prints", str(clean)], [str(clean)]):
+        rc, log = shim_rc(*flags)
+        assert rc == 0, (flags, log)
+    rc, log = shim_rc("--prints", str(noisy))
+    assert rc == 1 and "OB001" in log
+    assert shim_rc("--bogus")[0] == 2
