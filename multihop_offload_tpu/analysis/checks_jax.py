@@ -426,3 +426,54 @@ def check_jx005(mod: ModuleCtx) -> Iterator[Finding]:
                 message=msg + ", or waive with '# nondet-ok(<why>)'",
                 snippet=_snippet(mod, node),
             )
+
+
+# ---------------------------------------------------------------------------
+# JX006 — swallowed exceptions in the recovery-critical dirs
+# ---------------------------------------------------------------------------
+
+JX006_DIRS = ("serve", "loop", "train", "obs")
+
+
+def _pass_only(body) -> bool:
+    return all(isinstance(st, ast.Pass) for st in body)
+
+
+@rule(
+    id="JX006", severity="error",
+    scope="serve/ loop/ train/ obs/",
+    waiver="# swallow-ok(",
+    doc=("bare `except:` or `except Exception: pass` in a recovery-critical "
+         "dir — a swallowed error here hides the exact corruption the chaos "
+         "drills exist to surface; handle it, narrow it, or justify it"),
+    dirs=JX006_DIRS,
+)
+def check_jx006(mod: ModuleCtx) -> Iterator[Finding]:
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            yield Finding(
+                rule="JX006", path=mod.path, line=node.lineno,
+                message=("bare `except:` swallows SystemExit/KeyboardInterrupt "
+                         "and every error signal — catch a concrete type, or "
+                         "waive with '# swallow-ok(<why>)'"),
+                snippet=_snippet(mod, node),
+            )
+            continue
+        if not _pass_only(node.body):
+            continue
+        names = []
+        types = (node.type.elts if isinstance(node.type, ast.Tuple)
+                 else [node.type])
+        for t in types:
+            if isinstance(t, ast.Name):
+                names.append(t.id)
+        if any(n in ("Exception", "BaseException") for n in names):
+            yield Finding(
+                rule="JX006", path=mod.path, line=node.lineno,
+                message=("`except Exception: pass` silently swallows errors "
+                         "in a recovery-critical dir — handle or log the "
+                         "failure, or waive with '# swallow-ok(<why>)'"),
+                snippet=_snippet(mod, node),
+            )

@@ -1,0 +1,19 @@
+"""Deterministic fault-injection harness for the serve→loop→promote stack.
+
+`faults` is the only module imported here: the production code paths call
+its near-zero-cost `crashpoint()` / `io_gate()` hooks, and importing the
+drill matrix from package init would create an import cycle
+(obs/serve → chaos → drills → serve).  `mho-chaos` imports
+`chaos.drills` directly.
+"""
+
+from multihop_offload_tpu.chaos.faults import (  # noqa: F401
+    FaultPlan,
+    SimulatedCrash,
+    TransientIOError,
+    active_plan,
+    clear,
+    crashpoint,
+    install,
+    io_gate,
+)

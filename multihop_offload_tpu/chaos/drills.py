@@ -1,0 +1,727 @@
+"""The chaos drill matrix: inject every fault class, observe every recovery.
+
+One `ChaosSmoke` run builds a single tiny compiled service (a manual
+clock, one bucket) and drives every drill against it — kill-and-restart
+of the flywheel at mid-refit / mid-promotion / mid-rollback sites,
+checkpoint truncation and bit-flip, event-log torn final record and
+missing segment, slow/stuck ticks through the watchdog, backward clock
+skew, and transient I/O errors through the retry/backoff machinery.
+
+Every drill returns a record `{name, injected, recovered, checks{...},
+ok}`; the smoke asserts three global invariants on top:
+
+- decisions never wrong: after every crash-recovery the service answers a
+  golden request set bit-identically to the pre-fault champion (requests
+  are keyed by id, rollback re-pins the champion params) — faults may
+  DEGRADE service to the baseline, never silently change GNN decisions;
+- conservation: every admitted request is answered exactly once per
+  window (admitted == served, queue drains to zero), and every captured
+  outcome event is counted;
+- zero unexpected retraces after recovery: crash-resume and quarantine
+  fallback swap weights, never programs.
+
+Process death is simulated by `faults.crashpoint` raising
+`SimulatedCrash` (a BaseException — no recovery path can swallow it) out
+of `cli.loop.run_loop`; the "restarted process" re-enters `run_loop`
+against the same on-disk state with the executor's loaded-step cache
+cleared, exactly what a supervisor restart does.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Callable, Optional
+
+import numpy as np
+
+from multihop_offload_tpu.chaos import faults
+from multihop_offload_tpu.config import Config
+
+# the crash sites the kill drills (and tests/test_chaos.py) cover; one per
+# promote.py transition plus the long-running phases between them
+KILL_SITES = (
+    "capture:mid",
+    "refit:mid",
+    "refit:pre_save",
+    "refit:post_save",
+    "promote:pre_save",
+    "promote:post_save",
+    "promote:post_reload",
+    "monitor:mid",
+    "rollback:pre_save",
+    "rollback:post_save",
+)
+
+
+def smoke_config(cfg: Config, tmp: str) -> Config:
+    """Tiny single-bucket flywheel config shared by every drill: near-zero
+    LR so promotion gates pass deterministically, full capture, zero retry
+    backoff (drills inject transient failures on purpose)."""
+    return dataclasses.replace(
+        cfg,
+        serve_sizes="10", serve_buckets=1, serve_slots=4,
+        serve_queue_cap=64, serve_deadline_s=60.0,
+        model_root=os.path.join(tmp, "model"),
+        obs_log=os.path.join(tmp, "chaos_run.jsonl"),
+        obs_log_max_bytes=4096,
+        loop_capture_sample=1.0, loop_capture_requests=12,
+        loop_refit_steps=2, loop_refit_slots=2, loop_holdout_frac=0.25,
+        loop_sim_rounds=1, loop_sim_slots=60, loop_cycles=1,
+        loop_candidate_keep=1, loop_cooldown_s=0.0,
+        sim_cap=64, sim_margin=5.0,
+        learning_rate=1e-6, learning_decay=1.0,
+        io_retries=3, io_backoff_s=0.0,
+    )
+
+
+class ChaosSmoke:
+    """State shared across the drill matrix: ONE compiled service."""
+
+    def __init__(self, cfg: Config, tmp: str):
+        import jax
+
+        from multihop_offload_tpu.cli.serve import build_service
+
+        self.tmp = tmp
+        self.base = smoke_config(cfg, tmp)
+        self.t = {"now": 0.0}
+        self.clock: Callable[[], float] = lambda: self.t["now"]
+        self.service, self.pool = build_service(self.base, clock=self.clock)
+        # pristine weight snapshot: every drill starts from this champion
+        self.init_vars = jax.tree_util.tree_map(
+            np.asarray, self.service.executor.variables
+        )
+        self.golden: dict = {}
+        self.drills: list = []
+
+    # ---- shared plumbing ---------------------------------------------------
+
+    def _reset_service(self) -> None:
+        from multihop_offload_tpu.serve.metrics import ServingStats
+
+        ex = self.service.executor
+        ex.variables = {"params": self.init_vars["params"]}
+        ex.loaded_step = None
+        ex.loaded_lineage = None
+        self.service.stats = ServingStats()
+        self.service.watchdog = None
+        self.service._degraded_until.clear()
+        for q in self.service._queues:
+            q.clear()
+
+    def _drill_cfg(self, name: str) -> Config:
+        d = os.path.join(self.tmp, name.replace(":", "_"))
+        return dataclasses.replace(
+            self.base,
+            model_root=os.path.join(d, "model"),
+            obs_log=os.path.join(d, "run.jsonl"),
+        )
+
+    def _serve_ids(self, cfg: Config, id_offset: int, count: int = 6):
+        """Serve a deterministic window; returns {request_id: response}."""
+        from multihop_offload_tpu.serve.workload import request_stream
+
+        pending = list(request_stream(
+            self.pool, count, seed=cfg.seed + 1 + id_offset,
+            arrival_scale=cfg.arrival_scale, ul=cfg.ul_data, dl=cfg.dl_data,
+            t_max=float(cfg.T), id_offset=id_offset,
+        ))
+        pending.reverse()
+        out = {}
+        while pending or self.service.queue_depth:
+            while pending:
+                req = pending.pop()
+                if not self.service.submit(req):
+                    pending.append(req)
+                    break
+            for r in self.service.tick():
+                out[r.request_id] = r
+        return out
+
+    def _decisions_match(self, got: dict) -> bool:
+        """Golden check: every request either matches the champion's GNN
+        decision bit-for-bit or was EXPLICITLY degraded to the baseline —
+        wrong answers are the one unacceptable failure mode."""
+        for rid, ref in self.golden.items():
+            r = got.get(rid)
+            if r is None:
+                return False
+            if r.served_by == "baseline":
+                continue  # degraded, honestly labeled — allowed
+            if not (np.array_equal(r.dst, ref.dst)
+                    and np.array_equal(r.is_local, ref.is_local)):
+                return False
+        return True
+
+    def _run_flywheel(self, cfg: Config, plan: Optional[faults.FaultPlan],
+                      inject_regression: bool = True) -> tuple:
+        """One run_loop attempt under `plan`; returns (out, crash_site).
+        `out` is None when the injected crash killed the "process"."""
+        from multihop_offload_tpu import obs
+        from multihop_offload_tpu.cli.loop import run_loop
+
+        faults.install(plan)
+        runlog = obs.start_run(cfg, role="chaos")
+        try:
+            out = run_loop(cfg, inject_regression=inject_regression,
+                           service=self.service, pool=self.pool)
+            return out, None
+        except faults.SimulatedCrash as c:
+            return None, c.site
+        finally:
+            faults.clear()
+            obs.finish_run(runlog)
+
+    # ---- kill-and-restart drills -------------------------------------------
+
+    def run_baseline(self) -> dict:
+        """The uninterrupted reference cycle every kill drill must match:
+        promote at step 2, injected regression, rollback at step 3."""
+        self._reset_service()
+        cfg = self._drill_cfg("baseline")
+        out, site = self._run_flywheel(cfg, plan=None)
+        assert site is None and out is not None
+        self.baseline_terminal = {
+            "final_state": out["final_state"],
+            "final_loaded_step": out["final_loaded_step"],
+            "lineage_source": (out["final_lineage"] or {}).get("source"),
+            "lineage_parent_step":
+                (out["final_lineage"] or {}).get("parent_step"),
+        }
+        rec = {
+            "name": "baseline", "injected": None, "recovered": True,
+            "terminal": self.baseline_terminal,
+            "checks": {
+                "rolled_back": out["final_state"] == "rolled_back",
+                "rollback_lineage":
+                    self.baseline_terminal["lineage_source"] == "rollback",
+            },
+        }
+        # golden decisions on the champion params the rollback re-pinned
+        self.golden = self._serve_ids(cfg, id_offset=50_000)
+        rec["checks"]["golden_captured"] = len(self.golden) > 0
+        return self._finish(rec)
+
+    def run_kill(self, site: str) -> dict:
+        """SIGKILL-equivalent at `site`, then restart-and-resume: the
+        journaled state machine must reach the baseline's terminal state
+        and lineage, and the recovered service must answer the golden set
+        unchanged."""
+        self._reset_service()
+        cfg = self._drill_cfg(f"kill_{site}")
+        out, crashed_at = self._run_flywheel(
+            cfg, faults.FaultPlan(crash_at={site: 1})
+        )
+        killed = out is None and crashed_at == site
+        # "restart": a fresh process has no loaded-step cache and no queue
+        self.service.executor.loaded_step = None
+        self.service.executor.loaded_lineage = None
+        out2, site2 = self._run_flywheel(cfg, plan=None)
+        recovered = site2 is None and out2 is not None
+        terminal = {
+            "final_state": out2["final_state"] if recovered else None,
+            "final_loaded_step": out2["final_loaded_step"] if recovered else None,
+            "lineage_source":
+                ((out2["final_lineage"] or {}).get("source")
+                 if recovered else None),
+            "lineage_parent_step":
+                ((out2["final_lineage"] or {}).get("parent_step")
+                 if recovered else None),
+        }
+        resumed_from = (out2["cycles"][0].get("resumed_from")
+                        if recovered and out2["cycles"] else None)
+        got = self._serve_ids(cfg, id_offset=50_000) if recovered else {}
+        rec = {
+            "name": f"kill:{site}", "injected": f"SimulatedCrash at {site}",
+            "recovered": recovered, "terminal": terminal,
+            "resumed_from": resumed_from,
+            "checks": {
+                "crash_fired": killed,
+                "resumed": recovered,
+                "same_terminal": terminal == self.baseline_terminal,
+                "decisions_never_wrong": recovered
+                and self._decisions_match(got),
+                "conservation": (
+                    self.service.stats.admitted == self.service.stats.served
+                    and self.service.queue_depth == 0
+                ),
+            },
+        }
+        return self._finish(rec)
+
+    # ---- checkpoint corruption drills --------------------------------------
+
+    def _bootstrap_dir(self, cfg: Config) -> str:
+        from multihop_offload_tpu.cli.loop import _bootstrap_champion
+
+        self._reset_service()
+        _bootstrap_champion(cfg, self.service)
+        return os.path.join(cfg.model_dir(), "orbax")
+
+    def _corrupt_and_reload(self, name: str, corrupt) -> dict:
+        """Shared shape of truncation/bit-flip: save a GOOD step 2, corrupt
+        it, hot-reload — it must be quarantined with a typed event and the
+        service must keep serving step 1 (last-good), never crash, never
+        silently load corrupt bytes."""
+        import jax
+
+        from multihop_offload_tpu import obs
+        from multihop_offload_tpu.obs import events as obs_events
+        from multihop_offload_tpu.train import checkpoints as ckpt_lib
+
+        cfg = self._drill_cfg(name)
+        runlog = obs.start_run(cfg, role="chaos")
+        try:
+            directory = self._bootstrap_dir(cfg)
+            host = jax.tree_util.tree_map(
+                np.asarray, self.service.executor.variables
+            )
+            ckpt_lib.save_checkpoint(
+                directory, 2, {"params": host["params"]},
+                lineage=ckpt_lib.make_lineage("refit", parent_step=1),
+            )
+            n_corrupt = corrupt(directory)
+            step = self.service.hot_reload(cfg.model_dir())
+            served = self._serve_ids(cfg, id_offset=60_000)
+            quarantined = [
+                e for e in obs_events.read_events(cfg.obs_log)
+                if e.get("event") == "ckpt_quarantine"
+            ]
+            rec = {
+                "name": name,
+                "injected": f"{n_corrupt} bytes/files corrupted at step 2",
+                "recovered": True,
+                "checks": {
+                    "quarantine_event": len(quarantined) >= 1,
+                    "quarantine_dir_populated": bool(os.listdir(
+                        os.path.join(directory, "quarantine"))),
+                    "stayed_on_last_good":
+                        self.service.executor.loaded_step == 1
+                        and step in (None, 1),
+                    "kept_serving": len(served) > 0,
+                    "still_gnn_on_last_good": all(
+                        r.served_by == "gnn" for r in served.values()
+                    ),
+                },
+            }
+        finally:
+            obs.finish_run(runlog)
+        return self._finish(rec)
+
+    def run_ckpt_truncation(self) -> dict:
+        def corrupt(directory: str) -> int:
+            n = 0
+            for root, _, files in os.walk(os.path.join(directory, "2")):
+                for f in files:
+                    p = os.path.join(root, f)
+                    if os.path.getsize(p) > 0:
+                        faults.truncate_file(p, keep_fraction=0.3)
+                        n += 1
+            return n
+
+        return self._corrupt_and_reload("ckpt_truncation", corrupt)
+
+    def run_ckpt_bitflip(self) -> dict:
+        def corrupt(directory: str) -> int:
+            # flip bits in the LARGEST file under the step dir (the array
+            # data), leaving metadata parseable: this is the silent-load
+            # hole the content checksum exists to close
+            biggest, size = None, -1
+            for root, _, files in os.walk(os.path.join(directory, "2")):
+                for f in files:
+                    p = os.path.join(root, f)
+                    if os.path.getsize(p) > size:
+                        biggest, size = p, os.path.getsize(p)
+            faults.bit_flip_file(biggest, seed=self.base.seed, flips=16)
+            return 16
+
+        return self._corrupt_and_reload("ckpt_bitflip", corrupt)
+
+    # ---- event-log drills --------------------------------------------------
+
+    def _seeded_runlog(self, name: str):
+        """A rotated 3+ segment chain with a known final marker event."""
+        from multihop_offload_tpu.obs.events import RunLog, segment_paths
+
+        path = os.path.join(self.tmp, name, "log.jsonl")
+        log = RunLog(path, manifest={"event": "manifest", "drill": name},
+                     max_bytes=512)
+        for i in range(40):
+            log.emit("tick", n=i, payload="x" * 48)
+        log.emit("summary", marker="end-of-chain")
+        log.close()
+        return path, segment_paths(path)
+
+    def run_log_torn_record(self) -> dict:
+        """A byte-level torn write (invalid UTF-8, no newline) at the END
+        of a MID-CHAIN segment — the exact shape that used to look like
+        end-of-log and silently hide every later segment."""
+        from multihop_offload_tpu.obs.events import read_events
+
+        path, segs = self._seeded_runlog("log_torn")
+        torn_seg = segs[1]  # mid-chain, crash interrupted the rotation
+        faults.torn_tail(torn_seg)
+        events = list(read_events(path))
+        rec = {
+            "name": "log_torn_record",
+            "injected": f"torn invalid-UTF-8 tail on {os.path.basename(torn_seg)}",
+            "recovered": True,
+            "checks": {
+                "reader_reaches_final_segment": any(
+                    e.get("marker") == "end-of-chain" for e in events
+                ),
+                "events_from_all_other_segments":
+                    sum(1 for e in events if e.get("event") == "tick") >= 30,
+            },
+        }
+        return self._finish(rec)
+
+    def run_log_missing_segment(self) -> dict:
+        """A mid-chain segment deleted outright (lost volume, overeager
+        cleanup): the reader must span the hole, and the flywheel's
+        experience reader must still parse what survives."""
+        from multihop_offload_tpu.obs.events import read_events
+
+        path, segs = self._seeded_runlog("log_missing")
+        os.remove(segs[1])
+        events = list(read_events(path))
+        rec = {
+            "name": "log_missing_segment",
+            "injected": f"deleted {os.path.basename(segs[1])}",
+            "recovered": True,
+            "checks": {
+                "reader_reaches_final_segment": any(
+                    e.get("marker") == "end-of-chain" for e in events
+                ),
+                "manifest_still_first": bool(events)
+                and events[0].get("event") == "manifest",
+            },
+        }
+        return self._finish(rec)
+
+    # ---- watchdog / clock drills -------------------------------------------
+
+    def run_stuck_tick(self) -> dict:
+        """Slow then stuck dispatches on a manual clock: the watchdog must
+        classify both, dump a flight bundle on stuck, degrade the bucket to
+        the baseline for the recovery window, then restore the GNN."""
+        from multihop_offload_tpu.obs import events as obs_events
+        from multihop_offload_tpu.obs.flightrec import FlightRecorder
+        from multihop_offload_tpu.serve.watchdog import TickWatchdog
+
+        from multihop_offload_tpu import obs
+
+        cfg = self._drill_cfg("stuck_tick")
+        runlog = obs.start_run(cfg, role="chaos")
+        try:
+            self._bootstrap_dir(cfg)
+            flight_dir = os.path.join(self.tmp, "stuck_tick", "flight")
+            recorder = FlightRecorder(capacity=64, clock=self.clock)
+            wd = TickWatchdog(threshold_s=0.5, recovery_s=30.0,
+                              stuck_factor=10.0, recorder=recorder,
+                              flight_dir=flight_dir)
+            self.service.attach_watchdog(wd)
+            self.service.attach_health(recorder=recorder)
+
+            ex = self.service.executor
+            orig_run = ex.run
+            stall = {"s": 0.0}
+
+            def stalling_run(*a, **kw):
+                self.t["now"] += stall["s"]
+                return orig_run(*a, **kw)
+
+            ex.run = stalling_run
+            try:
+                stall["s"] = 1.0      # slow: 1.0 > 0.5, under 10x
+                slow_resp = self._serve_ids(cfg, id_offset=70_000, count=4)
+                stall["s"] = 6.0      # stuck: 6.0 > 0.5 * 10
+                stuck_resp = self._serve_ids(cfg, id_offset=70_100, count=4)
+                stall["s"] = 0.0      # wedge cleared, window still open
+                held_resp = self._serve_ids(cfg, id_offset=70_200, count=4)
+                self.t["now"] += 31.0  # recovery window expires
+                back_resp = self._serve_ids(cfg, id_offset=70_300, count=4)
+            finally:
+                ex.run = orig_run
+                self.service.attach_watchdog(None)
+                self.service.attach_health()
+            wd_events = [e for e in obs_events.read_events(cfg.obs_log)
+                         if e.get("event") in ("watchdog",
+                                               "watchdog_recovered")]
+            rec = {
+                "name": "stuck_tick",
+                "injected": "1 s then 6 s dispatch stalls (0.5 s threshold)",
+                "recovered": True,
+                "checks": {
+                    "slow_detected": wd.slow >= 1,
+                    "stuck_detected": wd.stuck >= 1,
+                    "flight_bundle_dumped": os.path.isdir(flight_dir)
+                    and bool(os.listdir(flight_dir)),
+                    "degraded_not_wrong": all(
+                        r.served_by == "baseline"
+                        for r in held_resp.values()
+                    ),
+                    "gnn_restored_after_recovery": all(
+                        r.served_by == "gnn" for r in back_resp.values()
+                    ),
+                    "recovered_event": any(
+                        e.get("event") == "watchdog_recovered"
+                        for e in wd_events
+                    ),
+                    "all_served": all(len(r) == 4 for r in (
+                        slow_resp, stuck_resp, held_resp, back_resp)),
+                },
+            }
+        finally:
+            obs.finish_run(runlog)
+        return self._finish(rec)
+
+    def run_clock_skew(self) -> dict:
+        """The clock steps BACKWARD mid-serving (NTP correction): no
+        watchdog trip, no negative latencies, decisions identical."""
+        from multihop_offload_tpu.obs.flightrec import FlightRecorder
+        from multihop_offload_tpu.serve.watchdog import TickWatchdog
+
+        cfg = self._drill_cfg("clock_skew")
+        self._bootstrap_dir(cfg)
+        wd = TickWatchdog(threshold_s=0.5, recovery_s=30.0,
+                          recorder=FlightRecorder(capacity=8,
+                                                  clock=self.clock))
+        self.service.attach_watchdog(wd)
+        try:
+            self.t["now"] += 1000.0
+            a = self._serve_ids(cfg, id_offset=80_000, count=4)
+            self.t["now"] -= 900.0   # backward skew between windows
+            b = self._serve_ids(cfg, id_offset=80_100, count=4)
+        finally:
+            self.service.attach_watchdog(None)
+        rec = {
+            "name": "clock_skew",
+            "injected": "clock stepped back 900 s mid-serving",
+            "recovered": True,
+            "checks": {
+                "no_watchdog_trip": wd.slow == 0 and wd.stuck == 0,
+                "no_negative_latency": all(
+                    r.latency_s >= 0.0
+                    for r in list(a.values()) + list(b.values())
+                ),
+                "still_gnn": all(r.served_by == "gnn"
+                                 for r in b.values()),
+            },
+        }
+        return self._finish(rec)
+
+    # ---- transient I/O + durability drills ---------------------------------
+
+    def run_transient_io(self) -> dict:
+        """Transient OSErrors injected at the three durable write sites —
+        orbax save, the loop journal, the event log — must be absorbed by
+        bounded retry-with-backoff, observable in `mho_io_retries_total`."""
+        import jax
+
+        from multihop_offload_tpu.loop.promote import PromotionController
+        from multihop_offload_tpu.obs.events import RunLog
+        from multihop_offload_tpu.obs.registry import registry as obs_registry
+        from multihop_offload_tpu.train import checkpoints as ckpt_lib
+
+        cfg = self._drill_cfg("transient_io")
+        directory = os.path.join(cfg.model_dir(), "orbax")
+        host = jax.tree_util.tree_map(
+            np.asarray, self.service.executor.variables
+        )
+        before = obs_registry().counter("mho_io_retries_total").total()
+        plan = faults.FaultPlan(io_fail={
+            "ckpt:save": 2, "journal:write": 2, "events:write": 2,
+        })
+        faults.install(plan)
+        try:
+            ckpt_lib.save_checkpoint(
+                directory, 1, {"params": host["params"]},
+                lineage=ckpt_lib.make_lineage("offline"),
+            )
+            ctl = PromotionController(cfg.model_dir())
+            ctl.transition("capturing", cycle=0)
+            log = RunLog(os.path.join(self.tmp, "transient_io", "log.jsonl"))
+            log.emit("tick", n=1)
+            log.close()
+        finally:
+            faults.clear()
+        after = obs_registry().counter("mho_io_retries_total").total()
+        resumed = PromotionController.resume(cfg.model_dir())
+        rec = {
+            "name": "transient_io",
+            "injected": "2 consecutive OSErrors at ckpt:save, "
+                        "journal:write, events:write",
+            "recovered": True,
+            "checks": {
+                "all_injected_faults_consumed": sum(
+                    plan.io_hits.values()) == 6,
+                "retries_counted": (after - before) >= 4,
+                "save_survived":
+                    ckpt_lib.latest_step(directory) == 1,
+                "journal_survived": resumed.state == "capturing",
+            },
+        }
+        return self._finish(rec)
+
+    def run_cooldown_restart(self) -> dict:
+        """A post-rollback cool-down must survive a process restart: the
+        deadline is journaled, so the restarted flywheel keeps refusing new
+        cycles until it passes (wall-clock scheduling needs durable
+        timers)."""
+        from multihop_offload_tpu.loop.promote import PromotionController
+
+        cfg = self._drill_cfg("cooldown")
+        ctl = PromotionController(cfg.model_dir(), clock=self.clock,
+                                  cooldown_s=120.0)
+        ctl.transition("rolled_back", step=3, reason="drill")
+        ctl.start_cooldown()
+        ctl2 = PromotionController.resume(cfg.model_dir(), clock=self.clock,
+                                          cooldown_s=120.0)
+        held = ctl2.cooldown_remaining()
+        self.t["now"] += 121.0
+        rec = {
+            "name": "cooldown_restart",
+            "injected": "restart 0 s into a 120 s post-rollback cool-down",
+            "recovered": True,
+            "checks": {
+                "cooldown_survived_restart": 0.0 < held <= 120.0,
+                "cooldown_expires": ctl2.cooldown_remaining() == 0.0,
+                "state_survived": ctl2.state == "rolled_back",
+            },
+        }
+        return self._finish(rec)
+
+    def run_candidate_gc(self) -> dict:
+        """Bounded candidate retention: three rejected-candidate
+        checkpoints, keep=1 — the two older ones must be deleted with
+        typed `gc` events."""
+        import jax
+
+        from multihop_offload_tpu import obs
+        from multihop_offload_tpu.loop.promote import PromotionController
+        from multihop_offload_tpu.obs import events as obs_events
+        from multihop_offload_tpu.train import checkpoints as ckpt_lib
+
+        cfg = self._drill_cfg("candidate_gc")
+        runlog = obs.start_run(cfg, role="chaos")
+        try:
+            ctl = PromotionController(cfg.model_dir(), candidate_keep=1)
+            host = jax.tree_util.tree_map(
+                np.asarray, self.service.executor.variables
+            )
+            for s in (1, 2, 3):
+                ckpt_lib.save_checkpoint(
+                    ctl.candidate_dir, s, {"params": host["params"]},
+                    lineage=ckpt_lib.make_lineage("refit"),
+                )
+            removed = ctl.gc_candidates(reason="drill")
+            gc_events = [e for e in obs_events.read_events(cfg.obs_log)
+                         if e.get("event") == "gc"]
+            rec = {
+                "name": "candidate_gc",
+                "injected": "3 stale candidates, retention keep=1",
+                "recovered": True,
+                "checks": {
+                    "older_deleted": removed == [1, 2],
+                    "newest_kept":
+                        ckpt_lib.all_steps(ctl.candidate_dir) == [3],
+                    "typed_gc_events": len(gc_events) == 2,
+                },
+            }
+        finally:
+            obs.finish_run(runlog)
+        return self._finish(rec)
+
+    # ---- retrace discipline ------------------------------------------------
+
+    def run_no_retrace_after_recovery(self) -> dict:
+        """After the whole drill matrix — crashes, quarantines, watchdog
+        degrades — serving one more window must trace nothing new: recovery
+        swaps weights, never programs."""
+        from multihop_offload_tpu.obs import jaxhooks
+
+        cfg = self._drill_cfg("no_retrace")
+        self._bootstrap_dir(cfg)
+        jaxhooks.install()
+        jaxhooks.mark_steady()
+        try:
+            served = self._serve_ids(cfg, id_offset=90_000, count=6)
+            retraces = jaxhooks.unexpected_retraces()
+        finally:
+            jaxhooks.clear_steady()
+        rec = {
+            "name": "no_retrace_after_recovery",
+            "injected": None,
+            "recovered": True,
+            "checks": {
+                "served": len(served) == 6,
+                "zero_unexpected_retraces": retraces == 0,
+            },
+        }
+        return self._finish(rec)
+
+    # ---- the matrix --------------------------------------------------------
+
+    def _finish(self, rec: dict) -> dict:
+        rec["ok"] = all(rec["checks"].values())
+        self.drills.append(rec)
+        return rec
+
+    def run_all(self) -> dict:
+        from multihop_offload_tpu.obs.registry import registry as obs_registry
+
+        self.run_baseline()
+        # kill-and-restart at a representative site per phase; the full
+        # 10-site matrix is pinned by tests/test_chaos.py
+        for site in ("refit:mid", "promote:post_save", "rollback:pre_save"):
+            self.run_kill(site)
+        self.run_ckpt_truncation()
+        self.run_ckpt_bitflip()
+        self.run_log_torn_record()
+        self.run_log_missing_segment()
+        self.run_stuck_tick()
+        self.run_clock_skew()
+        self.run_transient_io()
+        self.run_cooldown_restart()
+        self.run_candidate_gc()
+        self.run_no_retrace_after_recovery()
+        reg = obs_registry()
+        record = {
+            "drills": self.drills,
+            "counters": {
+                "quarantined": int(reg.counter(
+                    "mho_ckpt_quarantined_total").total()),
+                "io_retries": int(reg.counter(
+                    "mho_io_retries_total").total()),
+                "watchdog_slow": int(reg.counter(
+                    "mho_watchdog_slow_total").total()),
+                "watchdog_stuck": int(reg.counter(
+                    "mho_watchdog_stuck_total").total()),
+                "loop_resumes": int(reg.counter(
+                    "mho_loop_resumes_total").total()),
+                "ckpt_gc": int(reg.counter("mho_ckpt_gc_total").total()),
+            },
+            "checks": {
+                "all_drills_ok": all(d["ok"] for d in self.drills),
+                "drill_count": len(self.drills),
+                "fault_classes_covered": len(self.drills) - 2 >= 8,
+            },
+        }
+        record["ok"] = bool(record["checks"]["all_drills_ok"]
+                            and record["checks"]["fault_classes_covered"])
+        return record
+
+
+def run_smoke(cfg: Config) -> dict:
+    """The full drill matrix in one temp tree; asserts every drill's
+    recovery observed.  The committed record is `benchmarks/chaos_smoke.json`."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory(prefix="mho_chaos_smoke_") as tmp:
+        harness = ChaosSmoke(cfg, tmp)
+        record = harness.run_all()
+    failed = [d["name"] for d in record["drills"] if not d["ok"]]
+    assert record["ok"], f"chaos smoke failed: {failed or record['checks']}"
+    return record

@@ -1,0 +1,137 @@
+"""Named fault sites + seeded corruption helpers (stdlib-only).
+
+Production code marks its interruptible moments with `crashpoint("site")`
+and its fallible I/O with `io_gate("site")`.  Both are no-ops (one dict
+lookup) unless a drill has armed a `FaultPlan`, so the hooks are safe to
+leave in hot paths.  A drill arms a plan, runs the workload, and the hooks
+raise at exactly the named site:
+
+- `crashpoint` raises `SimulatedCrash` — a `BaseException` subclass so no
+  `except Exception` recovery path in the workload can swallow it; the
+  drill catches it at the top and "restarts the process" by re-running the
+  entry point against the same on-disk state (a SIGKILL equivalent).
+- `io_gate` raises `TransientIOError` (an `OSError`) for the first
+  `plan.fail_count` hits at the site — the retry/backoff machinery must
+  absorb it.
+
+Corruption helpers (`truncate_file`, `bit_flip_file`, `torn_tail`) mutate
+files the way real crashes and bit-rot do, seeded for determinism.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+from typing import Dict, Optional
+
+
+class SimulatedCrash(BaseException):
+    """Process death at a named site.  BaseException on purpose: recovery
+    code under test must never be able to catch and absorb it."""
+
+    def __init__(self, site: str):
+        super().__init__(f"simulated crash at {site}")
+        self.site = site
+
+
+class TransientIOError(OSError):
+    """An injected transient I/O failure (storage hiccup, flaky mount)."""
+
+
+class FaultPlan:
+    """One drill's armed faults.
+
+    crash_at: site name -> SimulatedCrash on the Nth hit (1-based, default
+    first).  io_fail: site name -> number of consecutive TransientIOErrors
+    to inject before letting the call through."""
+
+    def __init__(self, crash_at: Optional[Dict[str, int]] = None,
+                 io_fail: Optional[Dict[str, int]] = None):
+        self.crash_at = dict(crash_at or {})
+        self.io_fail = dict(io_fail or {})
+        self.hits: Dict[str, int] = {}       # crashpoint visit counts
+        self.io_hits: Dict[str, int] = {}    # io_gate injected-failure counts
+        self.fired: Dict[str, int] = {}      # site -> hit index that crashed
+
+
+_plan: Optional[FaultPlan] = None
+
+
+def install(plan: Optional[FaultPlan]) -> None:
+    global _plan
+    _plan = plan
+
+
+def clear() -> None:
+    install(None)
+
+
+def active_plan() -> Optional[FaultPlan]:
+    return _plan
+
+
+def crashpoint(site: str) -> None:
+    """Mark an interruptible moment.  No-op unless a plan arms `site`."""
+    p = _plan
+    if p is None:
+        return
+    n = p.hits.get(site, 0) + 1
+    p.hits[site] = n
+    want = p.crash_at.get(site)
+    if want is not None and n >= want:
+        del p.crash_at[site]           # fire once, then the restart survives
+        p.fired[site] = n
+        raise SimulatedCrash(site)
+
+
+def io_gate(site: str) -> None:
+    """Mark fallible I/O.  Raises TransientIOError for the first
+    `plan.io_fail[site]` hits, then lets calls through."""
+    p = _plan
+    if p is None:
+        return
+    left = p.io_fail.get(site, 0)
+    if left > 0:
+        p.io_fail[site] = left - 1
+        p.io_hits[site] = p.io_hits.get(site, 0) + 1
+        raise TransientIOError(f"injected transient I/O failure at {site}")
+
+
+# ---- seeded corruption helpers ---------------------------------------------
+
+
+def truncate_file(path: str, keep_fraction: float = 0.5) -> int:
+    """Truncate `path` to `keep_fraction` of its size (a partial write).
+    Returns the new size."""
+    size = os.path.getsize(path)
+    new = max(int(size * keep_fraction), 0)
+    with open(path, "r+b") as f:
+        f.truncate(new)
+    return new
+
+
+def bit_flip_file(path: str, seed: int, flips: int = 8) -> list:
+    """Flip `flips` seeded-random bits in `path` (bit-rot).  Returns the
+    byte offsets touched."""
+    rng = random.Random(seed)  # nondet-ok(seeded stdlib RNG: deterministic corruption pattern)
+    with open(path, "r+b") as f:
+        data = bytearray(f.read())
+        if not data:
+            return []
+        offsets = []
+        for _ in range(flips):
+            i = rng.randrange(len(data))
+            data[i] ^= 1 << rng.randrange(8)
+            offsets.append(i)
+        f.seek(0)
+        f.write(data)
+        f.truncate(len(data))
+    return offsets
+
+
+def torn_tail(path: str, garbage: bytes = b'{"event": "tick", "ts\xff\xfe') -> None:
+    """Append a torn final record — a partial JSON line with invalid UTF-8,
+    exactly what a crash mid-`write()` leaves behind (no trailing
+    newline)."""
+    with open(path, "ab") as f:
+        f.write(garbage)

@@ -53,9 +53,14 @@ def _bootstrap_champion(cfg: Config, service) -> int:
     return step
 
 
-def _capture_window(cfg: Config, service, pool, count: int, id_offset: int):
+def _capture_window(cfg: Config, service, pool, count: int, id_offset: int,
+                    site: str = "capture:mid"):
     """Drive `count` synthetic requests through submit/tick (closed loop,
-    `cli.serve` semantics) with capture on; returns (responses, next_id)."""
+    `cli.serve` semantics) with capture on; returns (responses, next_id).
+    `site` names the chaos crashpoint inside the loop: a window is
+    replayable (ids are deterministic from `id_offset`), so a kill here
+    resumes by re-serving the same window."""
+    from multihop_offload_tpu.chaos import faults
     from multihop_offload_tpu.serve.workload import request_stream
 
     pending = list(request_stream(
@@ -66,6 +71,7 @@ def _capture_window(cfg: Config, service, pool, count: int, id_offset: int):
     pending.reverse()
     responses = []
     while pending or service.queue_depth:
+        faults.crashpoint(site)
         while pending:
             req = pending.pop()
             if not service.submit(req):
@@ -86,6 +92,15 @@ def _window_tau(responses):
     return float(np.mean(taus)) if taus else None
 
 
+# resumable-phase order: a journaled state maps to the first phase the
+# resumed cycle still has to run (terminal states are not in here — a
+# resume on them starts the next cycle fresh)
+_PHASE_ORDER = {
+    "capturing": 0, "refitting": 1, "validating": 2, "promoting": 3,
+    "promoted": 4, "monitoring": 5, "rolling_back": 6,
+}
+
+
 def run_cycle(
     cfg: Config,
     model,
@@ -97,49 +112,98 @@ def run_cycle(
     inject_regression: bool = False,
     steady_after_validate: bool = False,
     drift_monitor=None,
+    resume_state=None,
 ):
-    """One full flywheel cycle; returns (record, next_id_offset)."""
+    """One full flywheel cycle; returns (record, next_id_offset).
+
+    `resume_state` (a journaled mid-cycle state from
+    `PromotionController.resume`) skips the phases a killed predecessor
+    already completed: outcomes are re-read from the durable event log,
+    the pinned candidate/champion/target steps come from the journal ctx,
+    and verified on-disk artifacts are reused instead of redone — so the
+    resumed cycle lands on the same terminal state and lineage as an
+    uninterrupted run."""
     from multihop_offload_tpu.loop.experience import (
         read_outcomes,
         split_holdout,
     )
     from multihop_offload_tpu.loop.promote import monitor_ok
-    from multihop_offload_tpu.loop.refit import refit_and_save
+    from multihop_offload_tpu.loop.refit import candidate_dir, refit_and_save
     from multihop_offload_tpu.loop.validate import ab_compare, apply_gates
     from multihop_offload_tpu.obs import jaxhooks
     from multihop_offload_tpu.obs.registry import registry as obs_registry
+    from multihop_offload_tpu.train import checkpoints as ckpt_lib
 
+    start = _PHASE_ORDER[resume_state] if resume_state else 0
+    if resume_state:
+        cycle = int(controller.ctx.get("cycle", cycle))
+        id_offset = int(controller.ctx.get("id_offset", id_offset))
     record: dict = {"cycle": cycle}
+    if resume_state:
+        record["resumed_from"] = resume_state
+    pre_tau = controller.ctx.get("pre_tau") if resume_state else None
+    cand_step = controller.ctx.get("candidate_step") if resume_state else None
+    cand_vars = None
+    champion_vars = None
+    cdir = candidate_dir(cfg.model_dir())
+
+    def _champion():
+        """The pre-promotion champion params: the live tree on a fresh
+        run, the journaled champion step on a resume past promotion (the
+        serving tree may already hold the bad candidate)."""
+        nonlocal champion_vars
+        if champion_vars is None:
+            cs = controller.ctx.get("champion_step")
+            restored, got = ckpt_lib.restore_verified(controller.directory,
+                                                      step=cs)
+            if restored is None:
+                raise RuntimeError(
+                    f"cannot resume: no verified champion at step {cs} "
+                    f"in {controller.directory}"
+                )
+            champion_vars = {"params": restored["params"]}
+        return champion_vars
+
+    def _candidate():
+        nonlocal cand_vars
+        if cand_vars is None:
+            restored = ckpt_lib.restore_checkpoint_raw(cdir, cand_step)
+            cand_vars = {"params": restored["params"]}
+        return cand_vars
 
     # ---- capture -----------------------------------------------------------
-    if drift_monitor is None:
-        controller.transition("capturing", cycle=cycle)
-        responses, id_offset = _capture_window(
-            cfg, service, pool, cfg.loop_capture_requests, id_offset
-        )
-    else:
-        # drift-gated entry (--loop_drift): serve a window FIRST, feed the
-        # new outcomes to the detectors, and only open a capture cycle when
-        # one trips — otherwise the flywheel stays idle on this traffic
-        responses, id_offset = _capture_window(
-            cfg, service, pool, cfg.loop_capture_requests, id_offset
-        )
-        fresh = read_outcomes(cfg.obs_log)[drift_monitor.samples:]
-        trips = drift_monitor.feed(fresh)
-        record["drift"] = {
-            "samples": drift_monitor.samples,
-            "trips": trips,
-        }
-        if not trips:
-            controller.transition("idle", cycle=cycle, reason="no drift")
-            record["skipped"] = "no drift detected"
-            record["pre_tau"] = _window_tau(responses)
-            return record, id_offset
-        controller.drift_triggered(trips[0], cycle=cycle)
-    pre_tau = _window_tau(responses)
+    if start <= 0:
+        if drift_monitor is None:
+            controller.transition("capturing", cycle=cycle,
+                                  id_offset=id_offset)
+            responses, id_offset = _capture_window(
+                cfg, service, pool, cfg.loop_capture_requests, id_offset
+            )
+        else:
+            # drift-gated entry (--loop_drift): serve a window FIRST, feed
+            # the new outcomes to the detectors, and only open a capture
+            # cycle when one trips — otherwise the flywheel stays idle on
+            # this traffic
+            responses, id_offset = _capture_window(
+                cfg, service, pool, cfg.loop_capture_requests, id_offset
+            )
+            fresh = read_outcomes(cfg.obs_log)[drift_monitor.samples:]
+            trips = drift_monitor.feed(fresh)
+            record["drift"] = {
+                "samples": drift_monitor.samples,
+                "trips": trips,
+            }
+            if not trips:
+                controller.transition("idle", cycle=cycle, reason="no drift")
+                record["skipped"] = "no drift detected"
+                record["pre_tau"] = _window_tau(responses)
+                return record, id_offset
+            controller.drift_triggered(trips[0], cycle=cycle)
+        pre_tau = _window_tau(responses)
+        record.update(served=len(responses), pre_tau=pre_tau)
+
     outcomes = read_outcomes(cfg.obs_log)
-    record.update(served=len(responses), outcomes=len(outcomes),
-                  pre_tau=pre_tau)
+    record["outcomes"] = len(outcomes)
     train, hold = split_holdout(outcomes, cfg.loop_holdout_frac)
     if not train or not hold:
         controller.transition("idle", reason="insufficient experience")
@@ -147,86 +211,125 @@ def run_cycle(
         return record, id_offset
 
     # ---- refit -------------------------------------------------------------
-    controller.transition("refitting", train=len(train), holdout=len(hold))
-    champion_vars = {"params": service.executor.variables["params"]}
-    cand_vars, cand_step, refit_info = refit_and_save(
-        model, champion_vars, train, cfg,
-        parent_step=service.executor.loaded_step, seed=cfg.seed + cycle,
-    )
-    record["refit"] = refit_info
+    if start <= 1:
+        champion_vars = {"params": service.executor.variables["params"]}
+        if cand_step is None:
+            cand_step = (ckpt_lib.latest_step(cdir) or 0) + 1
+        controller.transition(
+            "refitting", train=len(train), holdout=len(hold),
+            pre_tau=pre_tau, candidate_step=cand_step,
+            champion_step=service.executor.loaded_step,
+        )
+        if resume_state == "refitting" and ckpt_lib.has_verified(cdir,
+                                                                 cand_step):
+            # the killed run already finished its save: reuse the artifact
+            record["refit"] = {"reused": True}
+        else:
+            cand_vars, cand_step, refit_info = refit_and_save(
+                model, champion_vars, train, cfg,
+                parent_step=service.executor.loaded_step,
+                seed=cfg.seed + cycle, step=cand_step,
+            )
+            record["refit"] = refit_info
     record["candidate_step"] = cand_step
 
     # ---- validate ----------------------------------------------------------
-    controller.transition("validating")
-    scores = ab_compare(
-        model, champion_vars, cand_vars, hold,
-        rounds=cfg.loop_sim_rounds, slots_per_round=cfg.loop_sim_slots,
-        cap=cfg.sim_cap, margin=cfg.sim_margin, seed=cfg.seed,
-        round_to=cfg.round_to, precision=cfg.precision_policy,
-        dtype=cfg.jnp_dtype,
-    )
-    ok, reasons = apply_gates(
-        scores["champion"], scores["candidate"],
-        cfg.loop_gate_delivered_drop, cfg.loop_gate_tau_ratio,
-    )
-    record["ab"] = scores
-    record["gates"] = {
-        "ok": ok, "reasons": reasons,
-        "max_delivered_drop": cfg.loop_gate_delivered_drop,
-        "max_tau_ratio": cfg.loop_gate_tau_ratio,
-    }
-    if steady_after_validate:
-        # everything the rest of the cycle runs (serve ticks, orbax
-        # save/restore, hot-reload) has now compiled; promotion and
-        # rollback must not trace anything new
-        jaxhooks.mark_steady()
-    if not ok:
-        controller.reject("; ".join(reasons), candidate_step=cand_step)
-        return record, id_offset
+    if start <= 2:
+        controller.transition("validating")
+        scores = ab_compare(
+            model, _champion() if resume_state == "validating"
+            else champion_vars, _candidate(), hold,
+            rounds=cfg.loop_sim_rounds, slots_per_round=cfg.loop_sim_slots,
+            cap=cfg.sim_cap, margin=cfg.sim_margin, seed=cfg.seed,
+            round_to=cfg.round_to, precision=cfg.precision_policy,
+            dtype=cfg.jnp_dtype,
+        )
+        ok, reasons = apply_gates(
+            scores["champion"], scores["candidate"],
+            cfg.loop_gate_delivered_drop, cfg.loop_gate_tau_ratio,
+        )
+        record["ab"] = scores
+        record["gates"] = {
+            "ok": ok, "reasons": reasons,
+            "max_delivered_drop": cfg.loop_gate_delivered_drop,
+            "max_tau_ratio": cfg.loop_gate_tau_ratio,
+        }
+        if steady_after_validate:
+            # everything the rest of the cycle runs (serve ticks, orbax
+            # save/restore, hot-reload) has now compiled; promotion and
+            # rollback must not trace anything new
+            jaxhooks.mark_steady()
+        if not ok:
+            controller.reject("; ".join(reasons), candidate_step=cand_step)
+            return record, id_offset
 
     # ---- promote -----------------------------------------------------------
-    from multihop_offload_tpu.train import checkpoints as ckpt_lib
-
-    step = controller.promote(
-        service, cand_vars,
-        lineage=ckpt_lib.make_lineage(
-            "refit", parent_step=service.executor.loaded_step,
-            parent_dir=controller.directory, cfg=cfg,
-            extra={"candidate_step": cand_step},
-        ),
-        candidate_step=cand_step,
-        experience_ids=[o.request.request_id for o in train],
-    )
-    record["promoted_step"] = step
-    if step is None:
-        return record, id_offset
+    if start <= 3:
+        step = controller.promote(
+            service, _candidate(),
+            lineage=ckpt_lib.make_lineage(
+                "refit",
+                parent_step=controller.ctx.get(
+                    "champion_step", service.executor.loaded_step),
+                parent_dir=controller.directory, cfg=cfg,
+                extra={"candidate_step": cand_step},
+            ),
+            candidate_step=cand_step,
+            experience_ids=[o.request.request_id for o in train],
+            step=(controller.ctx.get("step")
+                  if resume_state == "promoting" else None),
+        )
+        record["promoted_step"] = step
+        if step is None:
+            return record, id_offset
+    else:
+        # past the promote phase: the promoted step is `step` in the ctx,
+        # except mid-rollback where ctx["step"] is the rollback target and
+        # the promoted (failed) step is `failed_step`
+        step = int(controller.ctx.get(
+            "failed_step" if resume_state == "rolling_back" else "step"))
+        record["promoted_step"] = step
 
     # ---- monitor -----------------------------------------------------------
-    controller.transition("monitoring", step=step)
-    monitor_n = max(cfg.loop_capture_requests // 2, 4)
-    responses_b, id_offset = _capture_window(
-        cfg, service, pool, monitor_n, id_offset
-    )
-    post_tau = _window_tau(responses_b)
-    record["post_tau_measured"] = post_tau
-    if inject_regression:
-        # forced regression: exercise the rollback path deterministically
-        # (the measured tau of a 2-step refit won't reliably regress)
-        post_tau = (pre_tau or 1.0) * cfg.loop_monitor_regression * 10.0
-        record["post_tau_injected"] = post_tau
-    if monitor_ok(pre_tau, post_tau, cfg.loop_monitor_regression):
-        controller.transition("idle", step=step)
+    do_rollback = False
+    rb_reason = ""
+    rb_step = None
+    if resume_state == "rolling_back":
+        do_rollback = True
+        rb_reason = str(controller.ctx.get("reason", "resumed rollback"))
+        rb_step = controller.ctx.get("step")
+        step = controller.ctx.get("failed_step")
     else:
+        controller.transition("monitoring", step=step)
+        monitor_n = max(cfg.loop_capture_requests // 2, 4)
+        responses_b, id_offset = _capture_window(
+            cfg, service, pool, monitor_n, id_offset, site="monitor:mid"
+        )
+        post_tau = _window_tau(responses_b)
+        record["post_tau_measured"] = post_tau
+        if inject_regression:
+            # forced regression: exercise the rollback path
+            # deterministically (the measured tau of a 2-step refit won't
+            # reliably regress)
+            post_tau = (pre_tau or 1.0) * cfg.loop_monitor_regression * 10.0
+            record["post_tau_injected"] = post_tau
+        if monitor_ok(pre_tau, post_tau, cfg.loop_monitor_regression):
+            controller.transition("idle", step=step)
+        else:
+            do_rollback = True
+            rb_reason = ("injected regression" if inject_regression
+                         else f"measured tau {post_tau} vs pre {pre_tau}")
+    if do_rollback:
         rb = controller.rollback(
-            service, champion_vars,
-            reason=("injected regression" if inject_regression
-                    else f"measured tau {post_tau} vs pre {pre_tau}"),
-            failed_step=step,
+            service, _champion(), reason=rb_reason, failed_step=step,
+            step=rb_step,
         )
         record["rollback_step"] = rb
         # the rolled-back service must keep serving
         responses_c, id_offset = _capture_window(
-            cfg, service, pool, max(monitor_n // 2, 4), id_offset
+            cfg, service, pool,
+            max(max(cfg.loop_capture_requests // 2, 4) // 2, 4), id_offset,
+            site="monitor:mid",
         )
         record["post_rollback_served"] = len(responses_c)
         record["post_rollback_tau"] = _window_tau(responses_c)
@@ -240,17 +343,32 @@ def run_cycle(
 
 
 def run_loop(cfg: Config, inject_regression: bool = False,
-             steady_after_validate: bool = False) -> dict:
-    """Build the service + controller and run `cfg.loop_cycles` cycles."""
+             steady_after_validate: bool = False, service=None,
+             pool=None, controller=None) -> dict:
+    """Build the service + controller and run `cfg.loop_cycles` cycles.
+
+    The controller comes back through `PromotionController.resume`: when
+    the journal sidecar says a previous process died mid-cycle, the first
+    cycle here continues from that journaled phase instead of restarting,
+    and a journaled cool-down (post-rollback) blocks new cycles until it
+    expires.  `service`/`pool`/`controller` are injectable so the chaos
+    drills can restart "the process" against one compiled service."""
     from multihop_offload_tpu.cli.serve import build_service
     from multihop_offload_tpu.loop.promote import PromotionController
     from multihop_offload_tpu.models import make_model
+    from multihop_offload_tpu.obs import events as obs_events
     from multihop_offload_tpu.obs import jaxhooks
     from multihop_offload_tpu.obs.events import segment_paths
 
-    service, pool = build_service(cfg)
+    if service is None:
+        service, pool = build_service(cfg, pool=pool)
     model = make_model(cfg)
-    controller = PromotionController(cfg.model_dir())
+    if controller is None:
+        controller = PromotionController.resume(
+            cfg.model_dir(),
+            candidate_keep=cfg.loop_candidate_keep,
+            cooldown_s=cfg.loop_cooldown_s,
+        )
     champion_step = _bootstrap_champion(cfg, service)
     drift_monitor = None
     if getattr(cfg, "loop_drift", False):
@@ -258,20 +376,34 @@ def run_loop(cfg: Config, inject_regression: bool = False,
 
         drift_monitor = DriftMonitor()
 
+    resume_state = (controller.state
+                    if controller.resumed and controller.state in _PHASE_ORDER
+                    else None)
     cycles = []
-    id_offset = 0
+    id_offset = (int(controller.ctx.get("id_offset", 0))
+                 if resume_state else 0)
     for c in range(max(cfg.loop_cycles, 1)):
+        wait = controller.cooldown_remaining()
+        if wait > 0 and not resume_state:
+            obs_events.emit("loop_cooldown_skip", cycle=c,
+                            remaining_s=round(wait, 3))
+            cycles.append({"cycle": c,
+                           "skipped": f"cooldown ({wait:.3f}s remaining)"})
+            continue
         rec, id_offset = run_cycle(
             cfg, model, service, pool, controller, id_offset, cycle=c,
             inject_regression=inject_regression,
             steady_after_validate=steady_after_validate and c == 0,
             drift_monitor=drift_monitor,
+            resume_state=resume_state,
         )
+        resume_state = None
         cycles.append(rec)
     return {
         "champion_bootstrap_step": champion_step,
         "cycles": cycles,
         "states": [h["state"] for h in controller.history],
+        "final_state": controller.state,
         "final_loaded_step": service.executor.loaded_step,
         "final_lineage": service.executor.loaded_lineage,
         "log_segments": len(segment_paths(cfg.obs_log)) if cfg.obs_log else 0,

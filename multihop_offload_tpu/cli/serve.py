@@ -31,7 +31,9 @@ def build_service(cfg: Config, pool=None, clock=None):
     from multihop_offload_tpu.models import make_model
     from multihop_offload_tpu.serve.service import OffloadService
     from multihop_offload_tpu.serve.workload import buckets_for_pool, case_pool
+    from multihop_offload_tpu.utils import durable
 
+    durable.configure(retries=cfg.io_retries, backoff_s=cfg.io_backoff_s)
     if pool is None:
         sizes = [int(s) for s in str(cfg.serve_sizes).split(",") if s.strip()]
         pool = case_pool(sizes, per_size=2, seed=cfg.seed)
@@ -55,6 +57,17 @@ def build_service(cfg: Config, pool=None, clock=None):
         trace=getattr(cfg, "obs_trace", True),
         **({"clock": clock} if clock is not None else {}),
     )
+    if cfg.health_watchdog_s > 0:
+        from multihop_offload_tpu.obs.flightrec import FlightRecorder
+        from multihop_offload_tpu.serve.watchdog import TickWatchdog
+
+        recorder = service.recorder or FlightRecorder(cfg.obs_flight_capacity)
+        service.attach_watchdog(TickWatchdog(
+            cfg.health_watchdog_s,
+            recovery_s=cfg.health_watchdog_recovery_s,
+            recorder=recorder,
+            flight_dir=cfg.model_dir(),
+        ))
     loaded = service.hot_reload(cfg.model_dir())
     print("serving with "
           + (f"checkpoint step {loaded} from {cfg.model_dir()}"

@@ -231,10 +231,33 @@ class Config:
     #                                cycle only enters `capturing` when a
     #                                detector trips on the outcome stream
     #                                (`drift_triggered` transitions)
+    loop_candidate_keep: int = 2   # bounded retention in orbax_candidate/:
+    #                                after a reject/rollback keep only the
+    #                                newest K candidate checkpoints, delete
+    #                                older ones with a typed `gc` event
+    loop_cooldown_s: float = 0.0   # post-rollback cool-down: no new flywheel
+    #                                cycle starts until this many seconds
+    #                                after the rollback (journaled, so it
+    #                                survives a process restart; 0 = off)
     # ---- health (obs/slo + flightrec; `mho-health`) ------------------------
     health_short_s: float = 60.0   # SLO burn-rate short window (seconds)
     health_long_s: float = 300.0   # SLO burn-rate long window (seconds)
     health_out: str = ""           # write the health-smoke JSON record here
+    health_watchdog_s: float = 0.0  # serve-tick watchdog: a bucket dispatch
+    #                                slower than this is `slow` (counter +
+    #                                event); one slower than 10x is `stuck`
+    #                                (flight-recorder dump + degrade the
+    #                                bucket to the greedy baseline). 0 = off
+    health_watchdog_recovery_s: float = 0.0  # how long a stuck bucket stays
+    #                                degraded-to-baseline before the GNN
+    #                                program is retried
+    # ---- durability (utils.durable; chaos drills) --------------------------
+    io_retries: int = 3            # bounded-retry attempts around fallible
+    #                                I/O (orbax save/restore, event-log
+    #                                writes, journal writes)
+    io_backoff_s: float = 0.05     # initial retry backoff (doubles per
+    #                                attempt)
+    chaos_out: str = ""            # write the chaos-smoke JSON record here
 
     @property
     def jnp_dtype(self):

@@ -1,8 +1,9 @@
 """Promotion controller: the flywheel's state machine, with rollback.
 
-States: idle -> capturing -> refitting -> validating -> {promoted |
-rejected} -> monitoring -> {ok -> idle | rolled_back}.  Transitions are
-host-side bookkeeping; the two state-changing actions are:
+States: idle -> capturing -> refitting -> validating -> {promoting ->
+promoted | rejected} -> monitoring -> {ok -> idle | rolling_back ->
+rolled_back}.  Transitions are host-side bookkeeping; the two
+state-changing actions are:
 
 - `promote`: pre-validate the candidate's param signature against the
   LIVE serving tree (`serve.executor.param_signature` — a mismatched tree
@@ -15,6 +16,17 @@ host-side bookkeeping; the two state-changing actions are:
   lineage pointing at the failed candidate) and hot-reloads.  The step
   counter stays monotone, the weights return.
 
+Durability: every transition is journaled to an atomically-written
+(`tmp`+`fsync`+`rename`) sidecar, `<model_dir>/loop_state.json`, BEFORE
+its side effects — `promoting` / `rolling_back` are write-ahead intents
+carrying the pinned target step, so a process killed mid-save resumes
+idempotently (`PromotionController.resume` + `cli.loop` phase dispatch)
+instead of restarting the cycle or double-saving.  Cool-down timers
+survive restarts the same way.  `ctx` is the journaled scratchpad: the
+fields of every transition merge into it, and `note()` adds
+cycle-progress facts (pre-promotion tau, champion step) between
+transitions.
+
 Every transition lands in the run log (`loop_state` events; `promotion` /
 `rollback` / `rejection` for the decisions) and the `mho_loop_*` counters,
 so `mho-obs` can render a flywheel run and Prometheus can alert on
@@ -24,32 +36,99 @@ rollback rate.
 from __future__ import annotations
 
 import os
+import time
 from typing import Any, List, Optional
 
 import jax
 import numpy as np
 
+from multihop_offload_tpu.chaos import faults
 from multihop_offload_tpu.obs import events as obs_events
 from multihop_offload_tpu.obs import trace as obs_trace
 from multihop_offload_tpu.obs.registry import registry as obs_registry
 from multihop_offload_tpu.serve.executor import param_signature
 from multihop_offload_tpu.train import checkpoints as ckpt_lib
+from multihop_offload_tpu.utils.durable import (
+    atomic_write_json,
+    load_json,
+    with_backoff,
+)
+
+JOURNAL_SCHEMA = 1
 
 STATES = (
     "idle", "capturing", "refitting", "validating",
-    "promoted", "rejected", "monitoring", "rolled_back",
+    "promoting", "promoted", "rejected", "monitoring",
+    "rolling_back", "rolled_back",
 )
 
 
 class PromotionController:
     """Drives candidate weights into (and back out of) the serving tree."""
 
-    def __init__(self, model_dir: str, which: str = "orbax"):
+    def __init__(self, model_dir: str, which: str = "orbax",
+                 clock=time.time, candidate_keep: int = 0,
+                 cooldown_s: float = 0.0):
         self.model_dir = model_dir
         self.which = which
         self.directory = os.path.join(model_dir, which)
+        self.candidate_dir = os.path.join(model_dir, f"{which}_candidate")
+        self.journal_path = os.path.join(model_dir, "loop_state.json")
+        self.clock = clock
+        self.candidate_keep = int(candidate_keep)
+        self.cooldown_s = float(cooldown_s)
         self.state = "idle"
+        self.seq = 0
+        self.cooldown_until = 0.0
+        self.ctx: dict = {}
+        self.resumed = False
         self.history: List[dict] = []
+
+    # ---- durable journal ---------------------------------------------------
+
+    @classmethod
+    def resume(cls, model_dir: str, which: str = "orbax", clock=time.time,
+               candidate_keep: int = 0,
+               cooldown_s: float = 0.0) -> "PromotionController":
+        """Rebuild the controller from the journal sidecar: state, seq,
+        cool-down deadline and ctx come back exactly as last journaled, so
+        a killed `mho-loop` continues the interrupted cycle from its last
+        durable transition.  A missing/unreadable journal (first boot, or
+        pre-durability trees) yields a fresh idle controller."""
+        ctl = cls(model_dir, which=which, clock=clock,
+                  candidate_keep=candidate_keep, cooldown_s=cooldown_s)
+        j = load_json(ctl.journal_path)
+        if j and j.get("schema") == JOURNAL_SCHEMA and j.get("state") in STATES:
+            ctl.state = j["state"]
+            ctl.seq = int(j.get("seq", 0))
+            ctl.cooldown_until = float(j.get("cooldown_until", 0.0))
+            ctl.ctx = dict(j.get("ctx") or {})
+            ctl.resumed = ctl.state != "idle"
+            if ctl.resumed:
+                obs_registry().counter(
+                    "mho_loop_resumes_total",
+                    "flywheel cycles resumed from the journal",
+                ).inc(state=ctl.state)
+                obs_events.emit("loop_resume", state=ctl.state, seq=ctl.seq,
+                                ctx=dict(ctl.ctx))
+        return ctl
+
+    def _journal(self) -> None:
+        payload = {
+            "schema": JOURNAL_SCHEMA,
+            "state": self.state,
+            "seq": self.seq,
+            "cooldown_until": self.cooldown_until,
+            "ctx": self.ctx,
+            "history_tail": self.history[-8:],
+        }
+
+        def _write() -> None:
+            faults.io_gate("journal:write")
+            atomic_write_json(self.journal_path, payload,
+                              site="journal:write")
+
+        with_backoff(_write, site="journal:write")
 
     # ---- state bookkeeping -------------------------------------------------
 
@@ -57,12 +136,36 @@ class PromotionController:
         if state not in STATES:
             raise ValueError(f"unknown loop state '{state}'; one of {STATES}")
         self.state = state
+        self.seq += 1
         rec = {"state": state, **fields}
         self.history.append(rec)
+        self.ctx.update(fields)
+        # durable first: the journal is the source of truth a restarted
+        # process resumes from, the event stream is an observer
+        self._journal()
         obs_events.emit("loop_state", **rec)
         obs_registry().counter(
             "mho_loop_transitions_total", "flywheel state transitions"
         ).inc(state=state)
+
+    def note(self, **fields) -> None:
+        """Journal cycle-progress facts without a state change (the pinned
+        candidate step, the pre-promotion tau, the champion step) so a
+        resume after SIGKILL has them."""
+        self.ctx.update(fields)
+        self._journal()
+
+    def start_cooldown(self, seconds: Optional[float] = None) -> None:
+        s = self.cooldown_s if seconds is None else float(seconds)
+        if s <= 0:
+            return
+        self.cooldown_until = float(self.clock()) + s
+        self._journal()
+        obs_events.emit("loop_cooldown", until=self.cooldown_until,
+                        seconds=s)
+
+    def cooldown_remaining(self) -> float:
+        return max(self.cooldown_until - float(self.clock()), 0.0)
 
     def _next_step(self) -> int:
         return (ckpt_lib.latest_step(self.directory) or 0) + 1
@@ -82,6 +185,17 @@ class PromotionController:
             fields["cycle"] = cycle
         self.transition("capturing", trigger="drift_triggered", **fields)
 
+    # ---- bounded candidate retention ---------------------------------------
+
+    def gc_candidates(self, reason: str) -> List[int]:
+        """Bounded retention in `orbax_candidate/`: rejected/rolled-back
+        candidates used to pile up forever; keep the newest K."""
+        if self.candidate_keep <= 0:
+            return []
+        return ckpt_lib.gc_checkpoints(self.candidate_dir,
+                                       keep=self.candidate_keep,
+                                       reason=reason)
+
     # ---- the two weight-moving actions -------------------------------------
 
     def promote(
@@ -91,10 +205,15 @@ class PromotionController:
         lineage: Optional[dict] = None,
         candidate_step: Optional[int] = None,
         experience_ids: Optional[List[int]] = None,
+        step: Optional[int] = None,
     ) -> Optional[int]:
         """Validated candidate -> serving tree -> hot-reload.
 
-        Returns the serving step it landed at, or None when the candidate
+        Journals a `promoting` intent with the pinned target step before
+        touching disk, and skips the save when that step already holds a
+        verified checkpoint — so a crash anywhere in here resumes by
+        calling `promote` again with `step=ctx["step"]` and lands in the
+        same place.  Returns the serving step, or None when the candidate
         was structurally rejected (wrong tree/shape/dtype signature — the
         service keeps serving the champion untouched)."""
         live = service.executor.variables["params"]
@@ -103,14 +222,19 @@ class PromotionController:
             self.reject("param signature mismatch against live tree",
                         candidate_step=candidate_step)
             return None
-        step = self._next_step()
-        host = jax.tree_util.tree_map(np.asarray, candidate_variables)
-        ckpt_lib.save_checkpoint(
-            self.directory, step, {"params": host["params"]},
-            lineage=lineage if lineage is not None
-            else ckpt_lib.make_lineage("refit", parent_step=candidate_step),
-        )
+        step = int(step) if step is not None else self._next_step()
+        self.transition("promoting", step=step, candidate_step=candidate_step)
+        faults.crashpoint("promote:pre_save")
+        if not ckpt_lib.has_verified(self.directory, step):
+            host = jax.tree_util.tree_map(np.asarray, candidate_variables)
+            ckpt_lib.save_checkpoint(
+                self.directory, step, {"params": host["params"]},
+                lineage=lineage if lineage is not None
+                else ckpt_lib.make_lineage("refit", parent_step=candidate_step),
+            )
+        faults.crashpoint("promote:post_save")
         loaded = service.hot_reload(self.model_dir, which=self.which)
+        faults.crashpoint("promote:post_reload")
         obs_registry().counter(
             "mho_loop_promotions_total", "candidates promoted to serving"
         ).inc()
@@ -132,20 +256,30 @@ class PromotionController:
         obs_events.emit("rejection", reason=reason,
                         candidate_step=candidate_step)
         self.transition("rejected", reason=reason)
+        self.gc_candidates(reason="rejected candidate")
 
     def rollback(self, service, champion_variables: Any, reason: str,
-                 failed_step: Optional[int] = None) -> int:
-        """Re-pin the champion snapshot at a fresh monotone step."""
-        step = self._next_step()
-        host = jax.tree_util.tree_map(np.asarray, champion_variables)
-        ckpt_lib.save_checkpoint(
-            self.directory, step, {"params": host["params"]},
-            lineage=ckpt_lib.make_lineage(
-                "rollback", parent_step=failed_step,
-                parent_dir=self.directory,
-                extra={"reason": reason},
-            ),
-        )
+                 failed_step: Optional[int] = None,
+                 step: Optional[int] = None) -> int:
+        """Re-pin the champion snapshot at a fresh monotone step.  Same
+        write-ahead-intent contract as `promote`: the `rolling_back`
+        journal entry pins the step, the save is skipped when already
+        verified, so a crashed rollback re-runs to the same lineage."""
+        step = int(step) if step is not None else self._next_step()
+        self.transition("rolling_back", step=step, reason=reason,
+                        failed_step=failed_step)
+        faults.crashpoint("rollback:pre_save")
+        if not ckpt_lib.has_verified(self.directory, step):
+            host = jax.tree_util.tree_map(np.asarray, champion_variables)
+            ckpt_lib.save_checkpoint(
+                self.directory, step, {"params": host["params"]},
+                lineage=ckpt_lib.make_lineage(
+                    "rollback", parent_step=failed_step,
+                    parent_dir=self.directory,
+                    extra={"reason": reason},
+                ),
+            )
+        faults.crashpoint("rollback:post_save")
         loaded = service.hot_reload(self.model_dir, which=self.which)
         obs_registry().counter(
             "mho_loop_rollbacks_total", "promotions rolled back"
@@ -153,6 +287,8 @@ class PromotionController:
         obs_events.emit("rollback", step=step, loaded=loaded,
                         reason=reason, failed_step=failed_step)
         self.transition("rolled_back", step=step, reason=reason)
+        self.start_cooldown()
+        self.gc_candidates(reason="rolled-back candidate")
         return step
 
 

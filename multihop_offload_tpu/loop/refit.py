@@ -29,6 +29,7 @@ from multihop_offload_tpu.agent.replay import (
     make_optimizer,
 )
 from multihop_offload_tpu.agent.train_step import forward_backward
+from multihop_offload_tpu.chaos import faults
 from multihop_offload_tpu.loop.experience import (
     Outcome,
     pad_for_outcomes,
@@ -102,6 +103,7 @@ def refit(
     losses = []
     with span("loop/refit", steps=steps, batches=len(batches)):
         for s in range(steps):
+            faults.crashpoint("refit:mid")
             binst, bjobs = batches[s % len(batches)]
             keys = jax.random.split(jax.random.fold_in(base_key, s), slots)
             params, opt_state, lc, lm = step_fn(
@@ -130,15 +132,22 @@ def refit_and_save(
     parent_step: Optional[int] = None,
     seed: int = 0,
     pad=None,
+    step: Optional[int] = None,
 ) -> tuple:
     """Run `refit` and persist the candidate with `source="refit"` lineage.
-    Returns (candidate_variables, candidate_step, info)."""
+    Returns (candidate_variables, candidate_step, info).
+
+    `step` pins the candidate step (crash-resume: the journal recorded the
+    intended step before the first attempt, so the redo lands at the same
+    id instead of latest+1)."""
     cand_vars, info = refit(
         model, variables, outcomes, cfg, seed=seed, pad=pad
     )
     directory = candidate_dir(cfg.model_dir())
-    step = (ckpt_lib.latest_step(directory) or 0) + 1
+    step = int(step) if step is not None else (
+        (ckpt_lib.latest_step(directory) or 0) + 1)
     host = jax.tree_util.tree_map(np.asarray, cand_vars)
+    faults.crashpoint("refit:pre_save")
     ckpt_lib.save_checkpoint(
         directory, step, host,
         lineage=ckpt_lib.make_lineage(
@@ -148,6 +157,7 @@ def refit_and_save(
                    "refit_steps": info["steps"]},
         ),
     )
+    faults.crashpoint("refit:post_save")
     obs_registry().counter(
         "mho_loop_refits_total", "candidate checkpoints produced"
     ).inc()

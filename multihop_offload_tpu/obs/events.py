@@ -30,6 +30,8 @@ import threading
 import time
 from typing import Iterator, List, Optional
 
+from multihop_offload_tpu.chaos import faults
+
 SCHEMA_VERSION = 1
 
 # event types with a typed helper; emit() accepts any type, the report
@@ -81,7 +83,7 @@ def run_manifest(cfg=None, role: str = "") -> dict:
 
         man["hostname"] = _platform.node()
         man["python"] = _platform.python_version()
-    except Exception:
+    except Exception:  # swallow-ok(manifest is best-effort; platform probes must never kill the run)
         pass
     try:
         import jax
@@ -103,7 +105,7 @@ def run_manifest(cfg=None, role: str = "") -> dict:
                     k: v for k, v in dataclasses.asdict(cfg).items()
                     if isinstance(v, (int, float, str, bool, type(None)))
                 }
-        except Exception:
+        except Exception:  # swallow-ok(config echo is best-effort; an odd cfg type must not kill the run)
             pass
     return man
 
@@ -123,10 +125,21 @@ class RunLog:
         self.path = path
         self.max_bytes = int(max_bytes) if max_bytes else 0
         self._lock = threading.Lock()
-        self._seq = 0          # next rotated-segment suffix
         self._bytes = 0        # bytes written to the active segment
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
+        # crash-restart semantics: a non-empty log already at `path` is a
+        # previous (possibly killed) run's — rotate it aside instead of
+        # truncating, so durable consumers (the flywheel's experience
+        # reader, crash-resume) keep every event already on disk
+        seq = 0
+        for p in segment_paths(path):
+            if p != path:
+                seq = max(seq, int(p.rsplit(".", 1)[1]) + 1)
+        if os.path.exists(path) and os.path.getsize(path) > 0:
+            os.replace(path, f"{path}.{seq:04d}")
+            seq += 1
+        self._seq = seq        # next rotated-segment suffix
         self._f = open(path, "w", buffering=1)  # line-buffered
         self._closed = False
         self._write(manifest if manifest is not None else run_manifest())
@@ -153,7 +166,23 @@ class RunLog:
             if (self.max_bytes and self._bytes
                     and self._bytes + len(line) > self.max_bytes):
                 self._rotate_locked()
-            self._f.write(line)
+            # bounded retry, hand-rolled: with_backoff's retry event would
+            # re-enter this very log (the lock is held), so only the
+            # registry counter records the retries here
+            for attempt in range(3):
+                try:
+                    faults.io_gate("events:write")
+                    self._f.write(line)
+                    break
+                except OSError:
+                    if attempt == 2:
+                        raise
+                    from multihop_offload_tpu.obs.registry import registry as _reg
+
+                    _reg().counter(
+                        "mho_io_retries_total",
+                        "transient I/O failures retried",
+                    ).inc(site="events:write")
             self._bytes += len(line)
 
     def emit(self, event: str, **fields) -> None:
@@ -240,9 +269,22 @@ def segment_paths(path: str) -> List[str]:
 def read_events(path: str) -> Iterator[dict]:
     """Iterate a run log's rows across all rotated segments (oldest
     first); tolerates a truncated final line in any segment (a crashed
-    run's log must still render — and a crash can interrupt a rotation)."""
+    run's log must still render — and a crash can interrupt a rotation).
+
+    Torn writes are byte-level: a record cut mid-UTF-8-sequence used to
+    raise `UnicodeDecodeError` out of text-mode iteration, which killed
+    the generator and silently dropped every LATER segment — a torn
+    mid-chain record looked like end-of-log.  Decoding with
+    ``errors="replace"`` turns the torn bytes into a non-JSON line the
+    existing skip path drops, and the walk continues into ``.NNNN+1``.
+    A segment that vanishes between listing and open (a crashed rotation,
+    a pruned chain) is skipped the same way."""
     for seg in segment_paths(path) or [path]:
-        with open(seg) as f:
+        try:
+            f = open(seg, encoding="utf-8", errors="replace")
+        except OSError:
+            continue
+        with f:
             for line in f:
                 line = line.strip()
                 if not line:

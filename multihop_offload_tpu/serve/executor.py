@@ -130,12 +130,20 @@ class BucketExecutor:
         newer than what is loaded.  Returns the step loaded, or None when
         already current / no checkpoint exists.  Params must match the live
         tree's shapes — a wrong-architecture checkpoint fails loudly here
-        rather than as a shape error mid-dispatch."""
+        rather than as a shape error mid-dispatch.
+
+        The restore is integrity-checked (`ckpt_lib.restore_verified`): a
+        truncated or bit-flipped latest checkpoint is quarantined with a
+        typed event and the load falls back down the lineage to the newest
+        verified step — which is usually what is already serving, so the
+        swap becomes a no-op instead of a crash or a silent corrupt load."""
         directory = os.path.join(model_dir, which)
         step = ckpt_lib.latest_step(directory)
         if step is None or step == self.loaded_step:
             return None
-        restored = ckpt_lib.restore_checkpoint_raw(directory, step)
+        restored, step = ckpt_lib.restore_verified(directory)
+        if restored is None or step == self.loaded_step:
+            return None  # nothing verified newer: keep serving last-good
         cur = self.variables["params"]
 
         if param_signature(restored["params"]) != param_signature(cur):

@@ -38,6 +38,7 @@ from multihop_offload_tpu.serve.bucketing import (
 from multihop_offload_tpu.serve.executor import BucketExecutor
 from multihop_offload_tpu.serve.metrics import ServingStats
 from multihop_offload_tpu.serve.request import OffloadRequest, OffloadResponse
+from multihop_offload_tpu.utils.durable import with_backoff
 
 
 class OffloadService:
@@ -102,6 +103,11 @@ class OffloadService:
         # and a flight recorder fed one diagnostic row per tick
         self.slo = None
         self.recorder = None
+        # tick watchdog (attach_watchdog): per-bucket dispatch timing; a
+        # "stuck" verdict forces the bucket onto the greedy baseline until
+        # the recovery deadline in `_degraded_until` passes
+        self.watchdog = None
+        self._degraded_until: dict = {}
         self.stats = ServingStats()
         self._queues: List[Deque[Tuple[OffloadRequest, float]]] = [
             deque() for _ in buckets.pads
@@ -149,6 +155,12 @@ class OffloadService:
         self.slo = slo
         self.recorder = recorder
 
+    def attach_watchdog(self, watchdog) -> None:
+        """Wire a `serve.watchdog.TickWatchdog`: each bucket dispatch gets
+        timed on the service clock; a stuck verdict degrades that bucket to
+        the baseline program until the watchdog's recovery window passes."""
+        self.watchdog = watchdog
+
     def _sparse_fit(self, req: OffloadRequest, b: int) -> Optional[int]:
         """Escalate to the first bucket whose STATIC nnz pads also hold this
         request's edge lists.  Under the sparse layout an oversized edge
@@ -182,7 +194,18 @@ class OffloadService:
                 if not q:
                     continue
                 t_now = self.clock() if now is None else now
-                degraded = (t_now - q[0][1]) > self.deadline_s
+                held = self._degraded_until.get(b)
+                if held is not None and t_now >= held:
+                    # watchdog recovery window over: retry the GNN program
+                    del self._degraded_until[b]
+                    held = None
+                    obs_registry().counter(
+                        "mho_watchdog_recoveries_total",
+                        "buckets restored to the GNN program",
+                    ).inc(bucket=b)
+                    obs_events.emit("watchdog_recovered", bucket=b)
+                degraded = ((t_now - q[0][1]) > self.deadline_s
+                            or held is not None)
                 degraded_batches += int(degraded)
                 taken = [q.popleft() for _ in range(min(self.slots, len(q)))]
                 reqs = [r for r, _ in taken]
@@ -207,6 +230,15 @@ class OffloadService:
                     degraded=degraded, request_ids=ids,
                 )
                 t_done = self.clock() if now is None else now
+                if self.watchdog is not None:
+                    # clamp at zero: backward clock skew must not trip it
+                    verdict = self.watchdog.observe(
+                        b, max(t_done - t_now, 0.0), now=t_done
+                    )
+                    if verdict == "stuck" and self.watchdog.recovery_s > 0:
+                        self._degraded_until[b] = (
+                            t_done + self.watchdog.recovery_s
+                        )
                 batch_responses = demux_responses(
                     taken, out, "baseline" if degraded else "gnn", b, t_done
                 )
@@ -286,8 +318,14 @@ class OffloadService:
 
     def hot_reload(self, model_dir: str, which: str = "orbax") -> Optional[int]:
         """Poll the orbax tree and swap in a newer policy without restarting
-        (compiled programs take weights as arguments — no retrace)."""
-        step = self.executor.hot_reload(model_dir, which=which)
+        (compiled programs take weights as arguments — no retrace).
+        Transient I/O failures retry with bounded exponential backoff;
+        corruption is handled below this (quarantine + last-good fallback
+        in `executor.hot_reload`)."""
+        step = with_backoff(
+            lambda: self.executor.hot_reload(model_dir, which=which),
+            site="hot_reload",
+        )
         if step is not None:
             obs_registry().counter(
                 "mho_serve_hot_reloads_total",

@@ -5,18 +5,33 @@ Improves on the reference, which saves only model weights
 replay memory on resume (SURVEY.md §5.4): we checkpoint params + optimizer
 state + step + RNG seed state; the TF-format weight export for reference
 interop lives in `models.tf_import.save_reference_checkpoint`.
+
+Integrity: every save also writes an atomic `integrity/<step>.json`
+sidecar holding a content sha256 of the state tree.  `restore_verified`
+re-hashes on load; a truncated / bit-flipped / unreadable checkpoint is
+moved to `directory/quarantine/` (non-numeric, so orbax never sees it)
+with a typed `ckpt_quarantine` event, and the restore falls back to the
+next-newest verified step.  Transient I/O failures around save/restore
+retry with exponential backoff (`utils.durable.with_backoff`).
 """
 
 from __future__ import annotations
 
-import json
+import hashlib
 import os
 import time
-from typing import Any, Optional
+from typing import Any, List, Optional, Tuple
 
 import jax
 import numpy as np
 import orbax.checkpoint as ocp
+
+from multihop_offload_tpu.chaos import faults
+from multihop_offload_tpu.utils.durable import (
+    atomic_write_json,
+    load_json,
+    with_backoff,
+)
 
 
 def _manager(directory: str) -> ocp.CheckpointManager:
@@ -24,6 +39,32 @@ def _manager(directory: str) -> ocp.CheckpointManager:
         os.path.abspath(directory),
         options=ocp.CheckpointManagerOptions(max_to_keep=3, create=True),
     )
+
+
+def tree_checksum(tree: Any) -> str:
+    """Content sha256 of a pytree: (keystr, dtype, shape, raw bytes) per
+    leaf in keystr order — stable across container types, so a tree hashed
+    at save time matches the same data restored template-free."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    h = hashlib.sha256()
+    for p, x in sorted(flat, key=lambda kv: jax.tree_util.keystr(kv[0])):
+        a = np.ascontiguousarray(np.asarray(x))
+        h.update(jax.tree_util.keystr(p).encode())
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def _integrity_path(directory: str, step: int) -> str:
+    return os.path.join(os.path.abspath(directory), "integrity",
+                        f"{int(step)}.json")
+
+
+def load_integrity(directory: str, step: int) -> Optional[dict]:
+    """The integrity sidecar for `step`, or None when the checkpoint
+    predates integrity tracking (legacy saves restore unverified)."""
+    return load_json(_integrity_path(directory, step))
 
 
 def save_checkpoint(directory: str, step: int, state: Any,
@@ -34,17 +75,26 @@ def save_checkpoint(directory: str, step: int, state: Any,
     `directory/lineage/<step>.json` — outside the orbax step directory so
     orbax's strict layout checks never see it, and it survives template
     changes.  The promotion controller and `mho-obs` use it to answer
-    "where did the serving weights come from".
+    "where did the serving weights come from".  Both sidecars are written
+    atomically (tmp+fsync+rename); the integrity one carries the content
+    checksum `restore_verified` checks.
     """
-    with _manager(directory) as mgr:
-        mgr.save(step, args=ocp.args.StandardSave(state))
-        mgr.wait_until_finished()
+    def _save() -> None:
+        faults.io_gate("ckpt:save")
+        with _manager(directory) as mgr:
+            mgr.save(step, args=ocp.args.StandardSave(state))
+            mgr.wait_until_finished()
+
+    with_backoff(_save, site="ckpt:save")
+    atomic_write_json(_integrity_path(directory, step),
+                      {"step": int(step), "algo": "sha256",
+                       "sha256": tree_checksum(state)},
+                      site="ckpt:integrity")
     if lineage is not None:
         ldir = os.path.join(os.path.abspath(directory), "lineage")
-        os.makedirs(ldir, exist_ok=True)
-        with open(os.path.join(ldir, f"{int(step)}.json"), "w") as f:
-            json.dump({"step": int(step), **lineage}, f, sort_keys=True,
-                      default=str)
+        atomic_write_json(os.path.join(ldir, f"{int(step)}.json"),
+                          {"step": int(step), **lineage},
+                          site="ckpt:lineage")
 
 
 def make_lineage(source: str, parent_step: Optional[int] = None,
@@ -79,13 +129,7 @@ def load_lineage(directory: str, step: Optional[int] = None) -> Optional[dict]:
         return None
     path = os.path.join(os.path.abspath(directory), "lineage",
                         f"{int(step)}.json")
-    if not os.path.exists(path):
-        return None
-    try:
-        with open(path) as f:
-            return json.load(f)
-    except (ValueError, OSError):
-        return None
+    return load_json(path)
 
 
 def latest_step(directory: str) -> Optional[int]:
@@ -117,3 +161,134 @@ def restore_checkpoint_raw(directory: str, step: Optional[int] = None):
     # StandardRestore(None), so delegate rather than duplicate the
     # manager/step-resolution logic
     return restore_checkpoint(directory, None, step)
+
+
+# ---- integrity: verified restore, quarantine, retention --------------------
+
+
+def _step_dir(directory: str, step: int) -> str:
+    """The orbax step directory for `step` (default naming is the bare
+    number; scan tolerates zero-padded variants)."""
+    d = os.path.abspath(directory)
+    if os.path.isdir(d):
+        for name in sorted(os.listdir(d)):
+            if name.isdigit() and int(name) == int(step):
+                return os.path.join(d, name)
+    return os.path.join(d, str(int(step)))
+
+
+def quarantine_step(directory: str, step: int, reason: str) -> Optional[str]:
+    """Move a corrupt checkpoint's step directory into
+    `directory/quarantine/` (a non-numeric subdir orbax ignores, like
+    `lineage/`) so `latest_step` stops resolving to it, and emit the typed
+    `ckpt_quarantine` event + counter.  Returns the quarantine path, or
+    None when the step directory is already gone."""
+    from multihop_offload_tpu.obs import events as obs_events
+    from multihop_offload_tpu.obs.registry import registry as obs_registry
+
+    src = _step_dir(directory, step)
+    dst = None
+    if os.path.exists(src):
+        qdir = os.path.join(os.path.abspath(directory), "quarantine")
+        os.makedirs(qdir, exist_ok=True)
+        dst = os.path.join(qdir, os.path.basename(src))
+        n = 1
+        while os.path.exists(dst):
+            dst = os.path.join(qdir, f"{os.path.basename(src)}.{n}")
+            n += 1
+        os.replace(src, dst)
+    obs_registry().counter(
+        "mho_ckpt_quarantined_total", "corrupt checkpoints quarantined"
+    ).inc(dir=os.path.basename(os.path.abspath(directory)))
+    obs_events.emit("ckpt_quarantine", dir=os.path.abspath(directory),
+                    step=int(step), reason=reason, moved_to=dst)
+    return dst
+
+
+def restore_verified(directory: str, step: Optional[int] = None,
+                     sleep=time.sleep) -> Tuple[Any, Optional[int]]:
+    """Template-free restore with integrity checking and automatic
+    fallback: restore `step` (default latest), re-hash against the
+    integrity sidecar, and on any corruption signal — unreadable step,
+    checksum mismatch — quarantine the step and retry the next-newest.
+    Transient `OSError`s retry with backoff first.  Returns
+    `(state, step)`, or `(None, None)` when no verified checkpoint
+    survives."""
+    want = step
+    while True:
+        s = want if want is not None else latest_step(directory)
+        if s is None:
+            return None, None
+        want = None  # after the pinned attempt, fall back through latest
+        try:
+            def _restore():
+                faults.io_gate("ckpt:restore")
+                return restore_checkpoint_raw(directory, s)
+
+            restored = with_backoff(_restore, site="ckpt:restore",
+                                    sleep=sleep)
+        except FileNotFoundError as e:
+            quarantine_step(directory, s, f"missing data: {e}")
+            continue
+        except OSError:
+            raise  # transient budget exhausted: surface, don't quarantine
+        except Exception as e:  # orbax corruption errors come in many types
+            quarantine_step(directory, s, f"restore failed: {e}")
+            continue
+        integ = load_integrity(directory, s)
+        if integ is not None and tree_checksum(restored) != integ.get("sha256"):
+            quarantine_step(directory, s, "content checksum mismatch")
+            continue
+        return restored, s
+
+
+def has_verified(directory: str, step: int) -> bool:
+    """True when `step` exists, restores cleanly, and matches its
+    integrity sidecar — the idempotent-resume check (reuse the artifact a
+    crashed run already wrote instead of redoing the work)."""
+    try:
+        restored = restore_checkpoint_raw(directory, step)
+    except Exception:
+        return False
+    if restored is None:
+        return False
+    integ = load_integrity(directory, step)
+    return integ is not None and tree_checksum(restored) == integ.get("sha256")
+
+
+def all_steps(directory: str) -> List[int]:
+    if not os.path.isdir(directory):
+        return []
+    with _manager(directory) as mgr:
+        return sorted(mgr.all_steps())
+
+
+def gc_checkpoints(directory: str, keep: int, reason: str = "retention") -> List[int]:
+    """Bounded retention: delete all but the newest `keep` steps (step dir
+    + lineage + integrity sidecars), emitting a typed `gc` event per
+    deletion.  Used by the promotion controller so rejected candidates
+    don't pile up in `orbax_candidate/` forever."""
+    import shutil
+
+    from multihop_offload_tpu.obs import events as obs_events
+    from multihop_offload_tpu.obs.registry import registry as obs_registry
+
+    steps = all_steps(directory)
+    doomed = steps[:-int(keep)] if keep > 0 else steps
+    removed = []
+    for s in doomed:
+        sdir = _step_dir(directory, s)
+        if os.path.exists(sdir):
+            shutil.rmtree(sdir, ignore_errors=True)
+        for side in (_integrity_path(directory, s),
+                     os.path.join(os.path.abspath(directory), "lineage",
+                                  f"{int(s)}.json")):
+            if os.path.exists(side):
+                os.remove(side)
+        removed.append(s)
+        obs_registry().counter(
+            "mho_ckpt_gc_total", "checkpoints deleted by bounded retention"
+        ).inc(dir=os.path.basename(os.path.abspath(directory)))
+        obs_events.emit("gc", dir=os.path.abspath(directory), step=int(s),
+                        keep=int(keep), reason=reason)
+    return removed

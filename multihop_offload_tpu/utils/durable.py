@@ -1,0 +1,95 @@
+"""Crash-safe file primitives shared by the serving/flywheel stack.
+
+Two building blocks the chaos drills (`mho-chaos`) exercise directly:
+
+- `atomic_write_json` — the tmp + fsync + `os.replace` dance, so a reader
+  (or a process restarted after SIGKILL) only ever sees the old file or
+  the complete new one, never a torn half-write.
+- `with_backoff` — bounded retry with exponential backoff around I/O that
+  can fail transiently (a flaky filesystem, an orbax storage hiccup).
+  Retries only `OSError`; corruption-shaped failures (ValueError & co.)
+  must propagate so callers can quarantine, not spin.
+
+Both take their defaults from `configure()` so the entry points wire the
+`io_retries` / `io_backoff_s` config knobs once instead of threading them
+through every call site.  Sleep is injectable for deterministic tests.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Callable, Optional
+
+# module defaults, overridden by configure() from Config knobs
+_DEFAULTS = {"retries": 3, "backoff_s": 0.05}
+
+
+def configure(retries: Optional[int] = None,
+              backoff_s: Optional[float] = None) -> None:
+    """Install process-wide retry defaults (from Config.io_retries /
+    Config.io_backoff_s); None leaves a value unchanged."""
+    if retries is not None:
+        _DEFAULTS["retries"] = max(int(retries), 1)
+    if backoff_s is not None:
+        _DEFAULTS["backoff_s"] = max(float(backoff_s), 0.0)
+
+
+def with_backoff(fn: Callable[[], Any], *, site: str = "",
+                 retries: Optional[int] = None,
+                 backoff_s: Optional[float] = None,
+                 sleep: Callable[[float], None] = time.sleep) -> Any:
+    """Run `fn`, retrying transient `OSError` up to `retries` attempts with
+    exponential backoff (backoff_s, 2*backoff_s, ...).  Non-OSError
+    exceptions — the corruption signals — propagate immediately.  The final
+    failed attempt re-raises.  Emits an `io_retry` event and bumps
+    `mho_io_retries_total` per retry so drills can observe recovery."""
+    n = _DEFAULTS["retries"] if retries is None else max(int(retries), 1)
+    delay = _DEFAULTS["backoff_s"] if backoff_s is None else float(backoff_s)
+    for attempt in range(n):
+        try:
+            return fn()
+        except OSError as e:
+            if attempt == n - 1:
+                raise
+            from multihop_offload_tpu.obs import events as obs_events
+            from multihop_offload_tpu.obs.registry import registry as obs_registry
+
+            obs_registry().counter(
+                "mho_io_retries_total", "transient I/O failures retried"
+            ).inc(site=site or "unknown")
+            obs_events.emit("io_retry", site=site, attempt=attempt + 1,
+                            error=str(e))
+            if delay > 0:
+                sleep(delay * (2 ** attempt))
+
+
+def atomic_write_json(path: str, payload: dict, *, site: str = "") -> None:
+    """Write `payload` as JSON to `path` atomically: serialize to a
+    same-directory tmp file, fsync, `os.replace` over the target.  A crash
+    at any point leaves either the previous file or the new one intact.
+    Wrapped in `with_backoff` so a transient failure retries."""
+    path = os.path.abspath(path)
+    d = os.path.dirname(path)
+
+    def _write() -> None:
+        os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, sort_keys=True, default=str)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    with_backoff(_write, site=site or f"atomic_write:{os.path.basename(path)}")
+
+
+def load_json(path: str) -> Optional[dict]:
+    """Read a JSON file written by `atomic_write_json`; None when missing
+    or unparseable (a pre-atomic legacy file torn by a crash)."""
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None

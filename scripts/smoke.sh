@@ -1,11 +1,11 @@
 #!/usr/bin/env bash
-# One-command pre-merge smoke: lint + the two fast end-to-end CLI proofs.
+# One-command pre-merge smoke: lint + the fast end-to-end CLI proofs.
 #
 #   bash scripts/smoke.sh
 #
-# Chains (each must pass; total budget well under 90s on a CPU host):
+# Chains (each must pass; total budget a few minutes on a CPU host):
 #   1. bash scripts/lint.sh          — ruff (or the engine's pyflakes set)
-#      plus the repo's JAX-aware rules (JX001-JX005, MP001, SL001, OB001);
+#      plus the repo's JAX-aware rules (JX001-JX006, MP001, SL001, OB001);
 #   2. mho-lint --json               — the static-analysis engine alone,
 #      proving the JSON surface and the seeded-violation fixture dir
 #      (every rule must fire there — a rule that can't detect its target
@@ -19,7 +19,14 @@
 #      to end: capture -> refit -> sim-gated A/B -> promote through
 #      hot-reload (zero unexpected retraces) -> injected regression ->
 #      automatic rollback; writes benchmarks/loop_smoke.json;
-#   6. mho-health --smoke            — the health subsystem's closed-loop
+#   6. mho-chaos --smoke             — the seeded fault-injection drill
+#      matrix (<90 s): kill-and-restart at the journaled crash sites,
+#      checkpoint truncation/bit-flip -> quarantine + last-good fallback,
+#      torn/missing log segments, stuck ticks -> watchdog degrade/recover,
+#      clock skew, transient I/O -> bounded retry; decisions never wrong,
+#      conservation holds, zero unexpected retraces after recovery;
+#      writes benchmarks/chaos_smoke.json;
+#   7. mho-health --smoke            — the health subsystem's closed-loop
 #      breach drill: injected latency/overload burst -> SLO alert fires ->
 #      flight-recorder bundle dumps -> recovery resolves the alert ->
 #      drift detectors trip -> drift-triggered capture -> refit ->
@@ -33,10 +40,10 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
-echo "== [1/6] lint =="
+echo "== [1/7] lint =="
 bash scripts/lint.sh
 
-echo "== [2/6] mho-lint (engine: clean repo + every rule fires on seeds) =="
+echo "== [2/7] mho-lint (engine: clean repo + every rule fires on seeds) =="
 python -m multihop_offload_tpu.analysis.cli --json >/dev/null
 python - <<'EOF'
 import json, subprocess, sys
@@ -44,23 +51,26 @@ out = subprocess.run(
     [sys.executable, "-m", "multihop_offload_tpu.analysis.cli", "--json",
      "tests/fixtures/analysis_seeded"], capture_output=True, text=True)
 fired = {f["rule"] for f in json.loads(out.stdout)["findings"]}
-need = {"JX001", "JX002", "JX003", "JX004", "JX005",
+need = {"JX001", "JX002", "JX003", "JX004", "JX005", "JX006",
         "MP001", "SL001", "OB001"}
 missing = sorted(need - fired)
 assert not missing, f"rules silent on their seeded violations: {missing}"
 print(f"mho-lint: all {len(need)} repo rules fire on the seeded fixtures")
 EOF
 
-echo "== [3/6] mho-sim --smoke =="
+echo "== [3/7] mho-sim --smoke =="
 python -m multihop_offload_tpu.cli.sim --smoke
 
-echo "== [4/6] mho-sim --smoke --layout sparse =="
+echo "== [4/7] mho-sim --smoke --layout sparse =="
 python -m multihop_offload_tpu.cli.sim --smoke --layout sparse
 
-echo "== [5/6] mho-loop --smoke =="
+echo "== [5/7] mho-loop --smoke =="
 python -m multihop_offload_tpu.cli.loop --smoke
 
-echo "== [6/6] mho-health --smoke =="
+echo "== [6/7] mho-chaos --smoke =="
+python -m multihop_offload_tpu.cli.chaos --smoke
+
+echo "== [7/7] mho-health --smoke =="
 python -m multihop_offload_tpu.cli.health --smoke
 
 echo "smoke: all green"

@@ -1,0 +1,20 @@
+"""Seeded violation: JX006 (swallowed exceptions in a recovery-critical dir)."""
+
+
+def resume_state(path):
+    try:
+        with open(path) as f:
+            return f.read()
+    except Exception:
+        # JX006: a swallowed load failure here hides checkpoint corruption
+        pass
+    return None
+
+
+def cleanup(path):
+    try:
+        import os
+
+        os.remove(path)
+    except:  # JX006: bare except swallows even KeyboardInterrupt
+        pass

@@ -20,7 +20,7 @@ from multihop_offload_tpu.analysis.cli import main as lint_main
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SEEDED = os.path.join(REPO, "tests", "fixtures", "analysis_seeded")
-ALL_REPO_RULES = {"JX001", "JX002", "JX003", "JX004", "JX005",
+ALL_REPO_RULES = {"JX001", "JX002", "JX003", "JX004", "JX005", "JX006",
                   "MP001", "SL001", "OB001"}
 
 
@@ -315,6 +315,62 @@ def test_jx005_exempts_cli(tmp_path):
             return time.time()
     """})
     assert "JX005" not in rules_hit(rep)
+
+
+def test_jx006_swallowed_exceptions_tp_and_waived(tmp_path):
+    rep = run_on(tmp_path, {"serve/m.py": """\
+        def tp_bare(path):
+            try:
+                return open(path).read()
+            except:
+                pass
+
+        def tp_pass_only(path):
+            try:
+                return open(path).read()
+            except Exception:
+                pass
+
+        def waived(path):
+            try:
+                return open(path).read()
+            except Exception:  # swallow-ok(best-effort probe)
+                pass
+    """})
+    jx = [f for f in rep.findings if f.rule == "JX006"]
+    assert len(jx) == 2 and [f.line for f in jx] == [4, 10]
+    assert len([f for f in rep.waived if f.rule == "JX006"]) == 1
+
+
+def test_jx006_handled_and_narrow_excepts_are_fine(tmp_path):
+    rep = run_on(tmp_path, {"loop/m.py": """\
+        def narrow(path):
+            try:
+                return open(path).read()
+            except OSError:
+                pass
+
+        def handled(path):
+            try:
+                return open(path).read()
+            except Exception:
+                return None
+    """})
+    assert "JX006" not in rules_hit(rep)
+
+
+def test_jx006_scoped_to_recovery_dirs(tmp_path):
+    src = """\
+        def swallow(path):
+            try:
+                return open(path).read()
+            except Exception:
+                pass
+    """
+    rep = run_on(tmp_path, {"cli/m.py": src, "analysis/m.py": src})
+    assert "JX006" not in rules_hit(rep)
+    rep = run_on(tmp_path, {"obs/m.py": src})
+    assert "JX006" in rules_hit(rep)
 
 
 # ---------------------------------------------------------------------------

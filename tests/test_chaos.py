@@ -1,0 +1,286 @@
+"""Chaos harness: fault primitives, durable-write machinery, and the full
+kill-point matrix over the promotion state machine.
+
+The matrix is the core guarantee: a SIGKILL-equivalent at EVERY named
+crash site in the capture -> refit -> validate -> promote -> monitor ->
+rollback cycle, followed by a restart, must land the journaled state
+machine on the same terminal state and checkpoint lineage as an
+uninterrupted run — and the recovered service must answer a golden
+request set identically to the pre-fault champion.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from multihop_offload_tpu.chaos import faults
+from multihop_offload_tpu.chaos.drills import KILL_SITES, ChaosSmoke
+from multihop_offload_tpu.config import Config
+from multihop_offload_tpu.obs.registry import registry as obs_registry
+from multihop_offload_tpu.utils import durable
+
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+
+# ---- fault primitives -------------------------------------------------------
+
+
+def test_crashpoint_unarmed_is_noop():
+    faults.clear()
+    faults.crashpoint("anywhere")  # no plan installed: must not raise
+    faults.io_gate("anywhere")
+
+
+def test_crashpoint_fires_once_at_nth_hit():
+    plan = faults.FaultPlan(crash_at={"site": 3})
+    faults.install(plan)
+    try:
+        faults.crashpoint("site")
+        faults.crashpoint("site")
+        with pytest.raises(faults.SimulatedCrash) as e:
+            faults.crashpoint("site")
+        assert e.value.site == "site"
+        # fired once; the "restarted process" sails through the same site
+        faults.crashpoint("site")
+        assert plan.fired == {"site": 3}
+    finally:
+        faults.clear()
+
+
+def test_simulated_crash_escapes_except_exception():
+    """The whole point of BaseException: recovery code under test must
+    not be able to swallow a simulated SIGKILL."""
+    faults.install(faults.FaultPlan(crash_at={"s": 1}))
+    try:
+        with pytest.raises(faults.SimulatedCrash):
+            try:
+                faults.crashpoint("s")
+            except Exception:
+                pytest.fail("SimulatedCrash was swallowed")
+    finally:
+        faults.clear()
+
+
+def test_io_gate_counts_down_then_clears():
+    plan = faults.FaultPlan(io_fail={"w": 2})
+    faults.install(plan)
+    try:
+        for _ in range(2):
+            with pytest.raises(faults.TransientIOError):
+                faults.io_gate("w")
+        faults.io_gate("w")  # budget consumed: passes
+        assert plan.io_hits == {"w": 2}
+        assert isinstance(faults.TransientIOError("x"), OSError)
+    finally:
+        faults.clear()
+
+
+def test_corruption_helpers_are_deterministic(tmp_path):
+    p = str(tmp_path / "blob.bin")
+    with open(p, "wb") as f:
+        f.write(bytes(range(256)) * 4)
+    assert faults.truncate_file(p, keep_fraction=0.25) == 256
+    a = faults.bit_flip_file(p, seed=11, flips=4)
+    # same seed on identical bytes flips the same offsets back
+    assert faults.bit_flip_file(p, seed=11, flips=4) == a
+    with open(p, "rb") as f:
+        assert f.read() == bytes(range(256))  # double-flip restores
+    faults.torn_tail(p)
+    with open(p, "rb") as f:
+        assert not f.read().endswith(b"\n")  # torn: no record terminator
+
+
+# ---- durable-write machinery ------------------------------------------------
+
+
+def test_with_backoff_absorbs_transient_oserror():
+    obs_registry().reset()
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise OSError("hiccup")
+        return "ok"
+
+    slept = []
+    out = durable.with_backoff(flaky, site="t", retries=3, backoff_s=0.01,
+                               sleep=slept.append)
+    assert out == "ok" and calls["n"] == 3
+    assert slept == [0.01, 0.02]  # exponential
+    assert obs_registry().counter("mho_io_retries_total").total(site="t") == 2
+
+
+def test_with_backoff_exhausted_budget_raises():
+    def always():
+        raise OSError("down")
+
+    with pytest.raises(OSError):
+        durable.with_backoff(always, site="t", retries=2, backoff_s=0.0,
+                             sleep=lambda s: None)
+
+
+def test_with_backoff_non_oserror_propagates_immediately():
+    """Corruption signals (bad JSON, checksum mismatch) must NOT be
+    retried — they go to quarantine, not to backoff."""
+    calls = {"n": 0}
+
+    def corrupt():
+        calls["n"] += 1
+        raise ValueError("not transient")
+
+    with pytest.raises(ValueError):
+        durable.with_backoff(corrupt, retries=5, sleep=lambda s: None)
+    assert calls["n"] == 1
+
+
+def test_atomic_write_json_leaves_no_tmp_and_round_trips(tmp_path):
+    p = str(tmp_path / "deep" / "state.json")
+    durable.atomic_write_json(p, {"b": 2, "a": 1})
+    assert durable.load_json(p) == {"a": 1, "b": 2}
+    assert os.listdir(os.path.dirname(p)) == ["state.json"]  # no tmp debris
+    assert durable.load_json(str(tmp_path / "missing.json")) is None
+    (tmp_path / "garbage.json").write_text("{not json")
+    assert durable.load_json(str(tmp_path / "garbage.json")) is None
+
+
+# ---- checkpoint integrity ---------------------------------------------------
+
+
+def test_tree_checksum_is_content_keyed():
+    from multihop_offload_tpu.train.checkpoints import tree_checksum
+
+    t1 = {"params": {"w": np.arange(6, dtype=np.float32).reshape(2, 3)}}
+    t2 = {"params": {"w": np.arange(6, dtype=np.float32).reshape(2, 3)}}
+    assert tree_checksum(t1) == tree_checksum(t2)  # content, not identity
+    t2["params"]["w"][0, 0] += 1e-3
+    assert tree_checksum(t1) != tree_checksum(t2)  # any bit moves the hash
+    t3 = {"params": {"w": t1["params"]["w"].astype(np.float64)}}
+    assert tree_checksum(t1) != tree_checksum(t3)  # dtype is part of identity
+
+
+def test_corrupt_checkpoint_quarantined_and_last_good_wins(tmp_path):
+    from multihop_offload_tpu.train import checkpoints as ckpt_lib
+
+    obs_registry().reset()
+    d = str(tmp_path / "orbax")
+    good = {"params": {"w": np.ones((4,), np.float32)}}
+    newer = {"params": {"w": np.full((4,), 2.0, np.float32)}}
+    ckpt_lib.save_checkpoint(d, 1, good,
+                             lineage=ckpt_lib.make_lineage("offline"))
+    ckpt_lib.save_checkpoint(d, 2, newer,
+                             lineage=ckpt_lib.make_lineage("refit"))
+    assert ckpt_lib.has_verified(d, 2)
+    # rot every byte of step 2's array data
+    for root, _, files in os.walk(os.path.join(d, "2")):
+        for f in files:
+            p = os.path.join(root, f)
+            if os.path.getsize(p):
+                faults.bit_flip_file(p, seed=3, flips=32)
+    assert not ckpt_lib.has_verified(d, 2)
+    state, step = ckpt_lib.restore_verified(d)
+    assert step == 1  # fell through to last-good
+    np.testing.assert_array_equal(state["params"]["w"], good["params"]["w"])
+    assert os.path.isdir(os.path.join(d, "quarantine"))
+    assert ckpt_lib.all_steps(d) == [1]  # the corrupt step is gone
+    assert obs_registry().counter("mho_ckpt_quarantined_total").total() >= 1
+
+
+def test_gc_checkpoints_bounded_retention(tmp_path):
+    from multihop_offload_tpu.train import checkpoints as ckpt_lib
+
+    obs_registry().reset()
+    d = str(tmp_path / "cand")
+    t = {"params": {"w": np.zeros((2,), np.float32)}}
+    for s in (1, 2, 3):
+        ckpt_lib.save_checkpoint(d, s, t,
+                                 lineage=ckpt_lib.make_lineage("refit"))
+    assert ckpt_lib.gc_checkpoints(d, keep=1, reason="test") == [1, 2]
+    assert ckpt_lib.all_steps(d) == [3]
+    assert not os.path.exists(os.path.join(d, "lineage", "1.json"))
+    assert not os.path.exists(os.path.join(d, "integrity", "2.json"))
+    assert obs_registry().counter("mho_ckpt_gc_total").total() == 2
+    # keep <= 0 disables; nothing else to delete either way
+    assert ckpt_lib.gc_checkpoints(d, keep=2) == []
+
+
+# ---- journal durability -----------------------------------------------------
+
+
+def test_journal_round_trip_and_cooldown_survive_restart(tmp_path):
+    from multihop_offload_tpu.loop.promote import PromotionController
+
+    t = {"now": 100.0}
+    ctl = PromotionController(str(tmp_path), clock=lambda: t["now"],
+                              cooldown_s=60.0)
+    ctl.transition("refitting", candidate_step=5, champion_step=1)
+    ctl.note(pre_tau=0.42)
+    ctl.start_cooldown()
+    # "restart": a fresh controller over the same dir
+    ctl2 = PromotionController.resume(str(tmp_path),
+                                      clock=lambda: t["now"],
+                                      cooldown_s=60.0)
+    assert ctl2.resumed and ctl2.state == "refitting"
+    assert ctl2.ctx["candidate_step"] == 5
+    assert ctl2.ctx["pre_tau"] == 0.42
+    assert ctl2.cooldown_remaining() == 60.0
+    t["now"] += 61.0
+    assert ctl2.cooldown_remaining() == 0.0
+
+
+def test_fresh_dir_resumes_idle(tmp_path):
+    from multihop_offload_tpu.loop.promote import PromotionController
+
+    ctl = PromotionController.resume(str(tmp_path / "virgin"))
+    assert ctl.state == "idle" and not ctl.resumed
+
+
+# ---- watchdog ---------------------------------------------------------------
+
+
+def test_watchdog_verdicts_and_counters():
+    from multihop_offload_tpu.serve.watchdog import TickWatchdog
+
+    obs_registry().reset()
+    wd = TickWatchdog(threshold_s=1.0, stuck_factor=10.0)
+    assert wd.observe(0, 0.5) == "ok"
+    assert wd.observe(0, 2.0) == "slow"
+    assert wd.observe(0, 15.0) == "stuck"
+    assert wd.slow == 1 and wd.stuck == 1
+    reg = obs_registry()
+    assert reg.counter("mho_watchdog_slow_total").total(bucket="0") == 1
+    assert reg.counter("mho_watchdog_stuck_total").total(bucket="0") == 1
+    with pytest.raises(ValueError):
+        TickWatchdog(threshold_s=0.0)
+
+
+# ---- the kill-point matrix --------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def smoke(tmp_path_factory):
+    """One compiled service + the uninterrupted baseline cycle every kill
+    case must converge to."""
+    obs_registry().reset()
+    harness = ChaosSmoke(Config(seed=0, dtype="float32"),
+                         str(tmp_path_factory.mktemp("chaos")))
+    rec = harness.run_baseline()
+    assert rec["ok"], rec
+    return harness
+
+
+@pytest.mark.parametrize("site", KILL_SITES)
+def test_kill_and_resume_reaches_baseline_terminal(smoke, site):
+    rec = smoke.run_kill(site)
+    checks = rec["checks"]
+    assert checks["crash_fired"], f"{site}: fault never injected"
+    assert checks["resumed"], f"{site}: restart did not complete"
+    assert checks["same_terminal"], (
+        f"{site}: resumed terminal {rec['terminal']} != "
+        f"baseline {smoke.baseline_terminal}"
+    )
+    assert checks["decisions_never_wrong"], f"{site}: golden decisions moved"
+    assert checks["conservation"], f"{site}: requests lost or duplicated"
+    # the resumed run entered through the journaled phase, not from idle
+    assert rec["resumed_from"] is not None, f"{site}: journal not consulted"

@@ -272,8 +272,11 @@ def test_promotion_state_machine(tmp_path):
     assert reg.counter("mho_loop_promotions_total").total() == 1
     assert reg.counter("mho_loop_rejections_total").total() == 1
     assert reg.counter("mho_loop_rollbacks_total").total() == 1
+    # intent states ("promoting"/"rolling_back") are journaled BEFORE the
+    # save they announce, so a crash between intent and outcome resumes
     states = [h["state"] for h in ctl.history]
-    assert states == ["rejected", "promoted", "rolled_back"]
+    assert states == ["rejected", "promoting", "promoted",
+                      "rolling_back", "rolled_back"]
 
 
 def test_checkpoint_lineage_sidecar_round_trip(tmp_path):

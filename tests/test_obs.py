@@ -1,6 +1,7 @@
 """obs/ subsystem: registry, spans, run log, retrace hooks, report, CLI."""
 
 import json
+import os
 import threading
 
 import jax
@@ -204,6 +205,55 @@ def test_read_events_tolerates_truncated_tail(tmp_path):
         f.write('{"event": "step", "truncat')  # crashed mid-write
     rows = list(read_events(path))
     assert len(rows) == 1 and rows[0]["event"] == "manifest"
+
+
+def test_read_events_survives_torn_bytes_at_rotation_boundary(tmp_path):
+    """Regression: a crash can tear the FINAL record of a segment that had
+    already rotated — a partial JSON line cut mid-UTF-8-sequence, no
+    newline.  Text-mode iteration used to raise UnicodeDecodeError on the
+    invalid bytes, killing the reader generator so every LATER segment
+    silently vanished: a torn mid-chain record looked like end-of-log."""
+    path = str(tmp_path / "run.jsonl")
+    log = RunLog(path, manifest={"event": "manifest", "ts": 0.0},
+                 max_bytes=300)
+    for i in range(30):
+        log.emit("tick", n=i, pad="x" * 32)
+    log.close()
+    segs = obs_events.segment_paths(path)
+    assert len(segs) >= 3, "chain too short to put the tear mid-chain"
+    # tear the end of a MID-chain segment: truncate its last record and
+    # append bytes that are not valid UTF-8 (a real torn write is byte-,
+    # not character-, aligned)
+    with open(segs[1], "r+b") as f:
+        f.truncate(os.path.getsize(segs[1]) - 7)
+        f.seek(0, os.SEEK_END)
+        f.write(b'{"event": "tick", "ts\xff\xfe')
+    ns = [r["n"] for r in read_events(path) if r["event"] == "tick"]
+    # one record lost to the tear; everything in LATER segments survives
+    assert ns[-1] == 29
+    assert len(ns) >= 28
+    assert ns == sorted(ns)
+    # and a whole segment going missing doesn't hide the rest either
+    os.remove(segs[1])
+    ns2 = [r["n"] for r in read_events(path) if r["event"] == "tick"]
+    assert ns2[-1] == 29
+
+
+def test_runlog_restart_rotates_previous_segment_aside(tmp_path):
+    """Crash-restart semantics: re-opening a RunLog at a path holding a
+    previous (killed) run's events must preserve them as a rotated
+    segment, not truncate — durable consumers (crash-resume, the
+    flywheel's experience reader) need every outcome already on disk."""
+    path = str(tmp_path / "run.jsonl")
+    log = RunLog(path, manifest={"event": "manifest", "ts": 0.0})
+    log.emit("outcome", n=1)
+    log.close()
+    log2 = RunLog(path, manifest={"event": "manifest", "ts": 1.0})
+    log2.emit("outcome", n=2)
+    log2.close()
+    assert len(obs_events.segment_paths(path)) == 2
+    ns = [r["n"] for r in read_events(path) if r["event"] == "outcome"]
+    assert ns == [1, 2]  # the killed run's outcome survived the restart
 
 
 def test_span_emit_writes_event_row(tmp_path):
