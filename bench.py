@@ -57,49 +57,16 @@ def _load_cases(max_cases: int, rng):
     return recs
 
 
-# Peak dense-matmul throughput per chip (bf16 MXU, the number TPU MFU is
-# conventionally quoted against), by `jax.devices()[0].device_kind` substring.
-# Sources: published TPU spec sheets; unknown kinds report mfu=None rather
-# than invent a denominator.
-_PEAK_TFLOPS_BY_KIND = (
-    ("v6", 918.0),   # Trillium
-    ("v5p", 459.0),
-    ("v5e", 197.0),  # v5 lite
-    ("v5", 197.0),
-    ("v4", 275.0),
-    ("v3", 123.0),
-    ("v2", 46.0),
+# Peak-by-device-kind tables and the fori_loop/scan FLOP correction moved
+# into the prof layer (obs/prof.py) so the live MFU / HBM-fraction gauges
+# and this roofline record share ONE definition and can never disagree.
+# The aliases keep this file's call sites (and scripts importing them)
+# byte-compatible; obs.prof imports no jax at module scope, so the parent
+# process stays accelerator-free.
+from multihop_offload_tpu.obs.prof import (  # noqa: E402
+    peak_hbm_gbps as _peak_hbm_gbps,
+    peak_tflops as _peak_tflops,
 )
-
-
-def _peak_tflops(device_kind: str):
-    kind = (device_kind or "").lower()
-    for sub, peak in _PEAK_TFLOPS_BY_KIND:
-        if sub in kind:
-            return peak
-    return None
-
-
-# Published HBM bandwidth per chip (GB/s), same substring lookup.  The bench
-# step is bandwidth-bound (BENCH_r05: arithmetic intensity ~0.117), so the
-# fraction of peak HBM is the honest utilization number, not MFU.
-_PEAK_HBM_GBPS_BY_KIND = (
-    ("v6", 1640.0),  # Trillium
-    ("v5p", 2765.0),
-    ("v5e", 819.0),
-    ("v5", 819.0),
-    ("v4", 1228.0),
-    ("v3", 900.0),
-    ("v2", 700.0),
-)
-
-
-def _peak_hbm_gbps(device_kind: str):
-    kind = (device_kind or "").lower()
-    for sub, peak in _PEAK_HBM_GBPS_BY_KIND:
-        if sub in kind:
-            return peak
-    return None
 
 
 def _bench_precision():
@@ -148,25 +115,12 @@ def _hand_flop_count(pad_n, pad_l, pad_e, batch, cheb_k=1, layers=5, hidden=32,
     return batch * (apsp + fp + 3 * cheb)
 
 
-def _loop_corrected_flops(ca_flops, pad_n, pad_l, batch, fp_iters=10,
-                          fp_sites=5, fp_path="xla"):
-    """XLA cost_analysis charges fori_loop/scan/while bodies ONCE
-    (measured: benchmarks/flops_reconcile.json — the 7-iteration APSP
-    compiles to the same flop count as 1 iteration, and one APSP iteration
-    matches the analytic 2N^3*B within 1%).  MFU therefore uses this
-    corrected count: cost_analysis plus the (iters-1) uncharged APSP
-    squarings plus the uncharged fixed-point work at each of the step's ~5
-    fixed-point call sites.  The fixed-point term depends on which kernel
-    compiled in: the XLA scan has its body charged once (add fp_iters-1
-    passes); the Pallas kernel lowers to a custom call whose interior
-    cost_analysis does not see at all (add all fp_iters passes)."""
-    import math
-
-    apsp_iters = max(1, math.ceil(math.log2(max(pad_n - 1, 2))))
-    apsp_extra = (apsp_iters - 1) * 2.0 * batch * pad_n**3
-    fp_uncharged = fp_iters if fp_path == "pallas" else fp_iters - 1
-    fp_extra = fp_sites * fp_uncharged * 2.0 * batch * pad_l**2
-    return ca_flops + apsp_extra + fp_extra
+# the scan-interior correction likewise lives in the prof layer now; the
+# alias is pinned by tests/test_prof.py (`is` identity) so a fork of the
+# math in either place fails loudly
+from multihop_offload_tpu.obs.prof import (  # noqa: E402
+    scan_corrected_flops as _loop_corrected_flops,
+)
 
 
 def build_bench_batch():
@@ -327,26 +281,35 @@ def measure():
         with span("bench/compile"):
             compiled = step.lower(variables, binst, bjobs, keys).compile()
         run = compiled
-        ca = compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0] if ca else {}
-        if ca:
-            flops_per_step = float(ca.get("flops", 0.0)) or None
-            bytes_per_step = float(ca.get("bytes accessed", 0.0)) or None
-        # buffer-assignment view: argument bytes are what the step reads per
-        # call (the storage the precision policy halves); off-TPU this is
-        # the byte metric that still tracks dtype — CPU lowering upcasts
-        # bf16 compute to f32, so cost-analysis bytes barely move there
-        mem = compiled.memory_analysis()
-        if mem is not None:
-            argument_bytes = float(
-                getattr(mem, "argument_size_in_bytes", 0.0)) or None
-            temp_bytes = float(
-                getattr(mem, "temp_size_in_bytes", 0.0)) or None
-    except Exception as exc:  # cost analysis is diagnostic, never fatal
-        print(f"warning: AOT cost_analysis unavailable: {exc}", file=sys.stderr)
+        # cost/memory extraction is centralized in the prof layer (OB002);
+        # argument bytes are the buffer-assignment view — what the step
+        # reads per call (the storage the precision policy halves); off-TPU
+        # this is the byte metric that still tracks dtype, since CPU
+        # lowering upcasts bf16 compute to f32
+        from multihop_offload_tpu.obs.prof import extract_cost
+
+        facts = extract_cost(compiled)
+        flops_per_step = facts["flops"]
+        bytes_per_step = facts["bytes_accessed"]
+        argument_bytes = facts["argument_bytes"]
+        temp_bytes = facts["temp_bytes"]
+    except Exception as exc:  # AOT compile is an optimization, never fatal
+        print(f"warning: AOT compile unavailable: {exc}", file=sys.stderr)
+    compile_s = time.time() - t_compile
     if runlog is not None:
-        runlog.phase("bench/compile", time.time() - t_compile)
+        runlog.phase("bench/compile", compile_s)
+    # register with the prof layer: the bench step's gauges come from the
+    # same registry the serving/training programs feed, with the same
+    # fp_path-aware correction the roofline record uses below
+    from multihop_offload_tpu.obs import prof as obs_prof
+
+    obs_prof.prof_registry().register(
+        "bench/step", compile_s=compile_s,
+        flops=flops_per_step, bytes_accessed=bytes_per_step,
+        argument_bytes=argument_bytes, temp_bytes=temp_bytes,
+        correction=lambda f: obs_prof.scan_corrected_flops(
+            f, pad.n, pad.l, batch, fp_path=fp_path),
+    )
 
     # warmup (compile here only if the AOT path failed)
     t_warm = time.time()
@@ -371,6 +334,10 @@ def measure():
             out = run(variables, binst, bjobs, keys)
         jax.block_until_ready(out)
     dt = time.time() - t0
+    # the block_until_ready above is the timed loop's sync boundary: these
+    # reps ARE the accounted device window, so the live mho_program_mfu /
+    # mho_program_hbm_frac gauges for bench/step equal the roofline numbers
+    obs_prof.prof_registry().account("bench/step", dt, calls=reps)
     if runlog is not None:
         runlog.phase("bench/timed", dt, reps=reps, batch=batch)
 

@@ -118,3 +118,34 @@ def check_ob001(mod: ModuleCtx) -> Iterator[Finding]:
                          "'# print-ok(<why>)'"),
                 snippet=_snippet(mod, node),
             )
+
+
+# XLA introspection surface the prof layer owns; a direct call anywhere
+# else forks the cost/memory view away from the registered program facts
+_OB002_ATTRS = ("cost_analysis", "memory_analysis", "memory_stats")
+
+
+@rule(
+    id="OB002", severity="error",
+    scope="library code (obs/ and bench.py exempt — the prof layer owns "
+          "cost/memory introspection)",
+    waiver="# prof-ok(",
+    doc=("direct cost_analysis()/memory_analysis()/memory_stats() call "
+         "outside the prof layer — go through obs.prof (extract_cost / "
+         "ProgramRegistry) or obs.memwatch"),
+    exempt_dirs=("obs",), exempt_files=("bench.py",),
+)
+def check_ob002(mod: ModuleCtx) -> Iterator[Finding]:
+    for node in ast.walk(mod.tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _OB002_ATTRS):
+            yield Finding(
+                rule="OB002", path=mod.path, line=node.lineno,
+                message=(f"direct {node.func.attr}() outside the prof "
+                         "layer — cost/memory introspection is centralized "
+                         "in obs.prof / obs.memwatch so program facts and "
+                         "gauges share one view; waive with "
+                         "'# prof-ok(<why>)'"),
+                snippet=_snippet(mod, node),
+            )

@@ -1,0 +1,409 @@
+"""Performance-observability entry point (`mho-prof`) — the prof layer CLI.
+
+    mho-prof                        # peak tables + this host's resolved peaks
+    mho-prof capture --seconds N    # Perfetto/TensorBoard trace of the bench
+                                    # step running for ~N seconds (--out DIR)
+    mho-prof --smoke                # <90 s CPU drill; writes
+                                    # benchmarks/prof_smoke.json
+
+The smoke run is the proof the prof layer closes its loop: the bench step
+and a tiny serving bucket must register (flops / bytes / arithmetic
+intensity / compile time), the live MFU and HBM-fraction gauges for the
+bench step must agree with `bench.py`'s independently computed roofline
+within 1% (under injected fake peaks — the CPU drill of the gauge math),
+an injected SLO breach (latency burst + a `serve_mfu` utilization floor
+the fake peaks guarantee is violated) must grab a profiler capture bundle
+next to the flight-recorder dump, and per-call accounting must stay under
+the 2% observability overhead budget.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+
+from multihop_offload_tpu.config import Config, build_parser
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+# the smoke's injected peaks: tiny enough that corrected-flop rates give
+# O(1e-3) MFU values (exercising the gauge math end to end on CPU) and the
+# 0.5 utilization floor below is deterministically breached
+_FAKE_PEAK_TFLOPS = 1.0
+_FAKE_PEAK_HBM_GBPS = 10.0
+
+
+def _import_bench():
+    """Import the repo-root `bench` module (the canonical step workload)."""
+    if _REPO_ROOT not in sys.path:
+        sys.path.insert(0, _REPO_ROOT)
+    import bench
+
+    return bench
+
+
+def _bench_step(bench):
+    """Build the bench workload + jitted step exactly as `bench.measure`
+    does (auto kernels, default precision/layout).  Returns
+    (step, args, pad, batch, fp_path)."""
+    import jax
+
+    from multihop_offload_tpu.agent import forward_backward
+    from multihop_offload_tpu.ops.fixed_point import resolve_fixed_point
+    from multihop_offload_tpu.ops.minplus import resolve_apsp
+
+    model, variables, binst, bjobs, pad, batch = bench.build_bench_batch()
+    apsp_fn, _ = resolve_apsp("auto", pad.n)
+    fp_fn, fp_path = resolve_fixed_point("auto", pad.l)
+
+    @jax.jit
+    def step(variables, insts, jobs, keys):
+        outs = jax.vmap(
+            lambda i, jb, k: forward_backward(model, variables, i, jb, k,
+                                              explore=0.0, apsp_fn=apsp_fn,
+                                              fp_fn=fp_fn)
+        )(insts, jobs, keys)
+        return outs.grads, outs.loss_critic, outs.delays.job_total
+
+    keys = jax.random.split(jax.random.PRNGKey(1), batch)
+    return step, (variables, binst, bjobs, keys), pad, batch, fp_path
+
+
+def smoke_config(cfg: Config, tmp: str) -> Config:
+    """Tiny single-bucket service + a dedicated run log under `tmp`."""
+    return dataclasses.replace(
+        cfg,
+        serve_sizes="10", serve_buckets=1, serve_slots=4,
+        serve_queue_cap=16, serve_deadline_s=60.0,
+        model_root=os.path.join(tmp, "model"),
+        obs_log=os.path.join(tmp, "prof_run.jsonl"),
+    )
+
+
+def _dir_has_files(path: str) -> bool:
+    for _, _, files in os.walk(path):
+        if files:
+            return True
+    return False
+
+
+def run_smoke(cfg: Config) -> dict:
+    """bench parity -> serve registration -> injected breach capture ->
+    overhead budget, asserting every link.  See module doc."""
+    import tempfile
+    import time
+
+    # fake peaks MUST be pinned before the default registry's first
+    # account() resolves them from the (absent) device kind
+    os.environ["MHO_PROF_PEAK_TFLOPS"] = str(_FAKE_PEAK_TFLOPS)
+    os.environ["MHO_PROF_PEAK_HBM_GBPS"] = str(_FAKE_PEAK_HBM_GBPS)
+    os.environ.setdefault("BENCH_NETWORKS", "4")
+    os.environ.setdefault("BENCH_INSTANCES", "2")
+
+    import jax
+
+    from multihop_offload_tpu import obs
+    from multihop_offload_tpu.cli.serve import build_service
+    from multihop_offload_tpu.obs import events as obs_events
+    from multihop_offload_tpu.obs import prof as obs_prof
+    from multihop_offload_tpu.obs.flightrec import FlightRecorder
+    from multihop_offload_tpu.obs.memwatch import memwatch
+    from multihop_offload_tpu.obs.registry import registry as obs_registry
+    from multihop_offload_tpu.obs.report import _program_gauge
+    from multihop_offload_tpu.obs.slo import SLOEngine, default_serving_slos
+    from multihop_offload_tpu.serve.workload import request_stream
+
+    bench = _import_bench()
+    prof = obs_prof.prof_registry()
+    reps = int(os.environ.get("PROF_SMOKE_REPS", 10))
+
+    with tempfile.TemporaryDirectory(prefix="mho_prof_smoke_") as tmp:
+        scfg = smoke_config(cfg, tmp)
+        runlog = obs.start_run(scfg, role="prof")
+        record: dict = {
+            "platform": jax.default_backend(),
+            "fake_peaks": {"tflops": _FAKE_PEAK_TFLOPS,
+                           "hbm_gbps": _FAKE_PEAK_HBM_GBPS},
+            "reps": reps,
+        }
+        try:
+            # ---- bench leg: register + account exactly as bench.measure
+            step, args, pad, batch, fp_path = _bench_step(bench)
+            t_c = time.perf_counter()
+            compiled = step.lower(*args).compile()
+            compile_s = time.perf_counter() - t_c
+            facts = obs_prof.extract_cost(compiled)
+            prof.register(
+                "bench/step", compile_s=compile_s,
+                flops=facts["flops"], bytes_accessed=facts["bytes_accessed"],
+                argument_bytes=facts["argument_bytes"],
+                temp_bytes=facts["temp_bytes"],
+                correction=lambda f: obs_prof.scan_corrected_flops(
+                    f, pad.n, pad.l, batch, fp_path=fp_path),
+            )
+            out = compiled(*args)          # warmup outside the timed window
+            jax.block_until_ready(out)
+            memwatch().snapshot("bench_warmup")
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                out = compiled(*args)
+            jax.block_until_ready(out)
+            dt = time.perf_counter() - t0
+            prof.account("bench/step", dt, calls=reps)
+            memwatch().snapshot("bench_timed")
+
+            # independent roofline, the way bench.measure computes it —
+            # the live gauges must agree within 1%
+            steps_per_sec = reps / dt
+            flops_corr = bench._loop_corrected_flops(
+                facts["flops"], pad.n, pad.l, batch, fp_path=fp_path)
+            roof_mfu = (flops_corr * steps_per_sec / 1e12) / _FAKE_PEAK_TFLOPS
+            roof_hbm = ((facts["bytes_accessed"] * steps_per_sec / 1e9)
+                        / _FAKE_PEAK_HBM_GBPS)
+            snap = obs_registry().snapshot()
+            gauge_mfu = _program_gauge(
+                snap, "mho_program_mfu").get("bench/step")
+            gauge_hbm = _program_gauge(
+                snap, "mho_program_hbm_frac").get("bench/step")
+            mfu_err = (abs(gauge_mfu - roof_mfu) / roof_mfu
+                       if gauge_mfu and roof_mfu else None)
+            hbm_err = (abs(gauge_hbm - roof_hbm) / roof_hbm
+                       if gauge_hbm and roof_hbm else None)
+            record["bench"] = {
+                "batch": batch, "dt_s": round(dt, 4),
+                "compile_s": round(compile_s, 3), "fp_path": fp_path,
+                "roofline_mfu": roof_mfu, "gauge_mfu": gauge_mfu,
+                "mfu_rel_err": mfu_err,
+                "roofline_hbm_frac": roof_hbm, "gauge_hbm_frac": gauge_hbm,
+                "hbm_rel_err": hbm_err,
+            }
+
+            # ---- serve leg: a real BucketExecutor program registers ----
+            t = {"now": 0.0}
+            service, pool = build_service(scfg, clock=lambda: t["now"])
+            reqs = request_stream(
+                pool, 8, seed=scfg.seed + 1,
+                arrival_scale=scfg.arrival_scale,
+                ul=scfg.ul_data, dl=scfg.dl_data, t_max=float(scfg.T),
+            )
+            served = []
+            pending = list(reqs)
+            while pending or service.queue_depth:
+                for _ in range(4):
+                    if pending:
+                        service.submit(pending.pop())
+                t["now"] += 0.01
+                served.extend(service.tick())
+            memwatch().snapshot("serve")
+            serve_programs = [n for n in prof.names()
+                              if n.startswith("serve/")]
+            record["serve"] = {"served": len(served),
+                               "programs": serve_programs}
+
+            # ---- injected breach -> flight dump + profiler capture -----
+            engine = SLOEngine(
+                default_serving_slos(latency_le=0.05, mfu_floor=0.5),
+                short_s=2.0, long_s=8.0,
+            )
+            recorder = FlightRecorder(capacity=scfg.obs_flight_capacity,
+                                      clock=lambda: t["now"])
+            breach_dir = os.path.join(tmp, "breach")
+            capture = obs_prof.BreachCapture(
+                breach_dir, slos=("serve_p99", "serve_mfu"),
+                clock=lambda: t["now"],
+                fn=lambda: jax.block_until_ready(compiled(*args)),
+            )
+            bundles = []
+            engine.on_breach(lambda spec, info: bundles.append(
+                recorder.dump(breach_dir, spec.name,
+                              alerts=engine.state(), extra={"alert": info})
+            ))
+            engine.on_breach(capture.on_breach)
+            lat = obs_registry().histogram(
+                "mho_serve_latency_seconds", "queue+serve latency"
+            )
+            alerts = []
+            for tick in range(12):
+                lat.observe(0.5)          # every observation busts the bound
+                t["now"] += 1.0
+                alerts.extend(engine.observe(t["now"]))
+            record["breach"] = {
+                "alerts": alerts,
+                "flight_bundles": [os.path.basename(b) for b in bundles if b],
+                "captures": [os.path.relpath(c, tmp)
+                             for c in capture.captures],
+            }
+
+            # ---- per-call accounting overhead (interleaved min-of-3) ---
+            oreps = max(4, reps // 2)
+            bare_legs, inst_legs = [], []
+            for _ in range(3):
+                tb = time.perf_counter()
+                for _ in range(oreps):
+                    out = compiled(*args)
+                jax.block_until_ready(out)
+                bare_legs.append(time.perf_counter() - tb)
+                ti = time.perf_counter()
+                for _ in range(oreps):
+                    out = compiled(*args)
+                    prof.account("prof_smoke/overhead",
+                                 0.0)  # the accounting call IS the payload
+                jax.block_until_ready(out)
+                inst_legs.append(time.perf_counter() - ti)
+            overhead = min(inst_legs) / min(bare_legs) - 1.0
+            record["overhead"] = {
+                "reps_per_leg": oreps,
+                "bare_legs_s": [round(x, 4) for x in bare_legs],
+                "instrumented_legs_s": [round(x, 4) for x in inst_legs],
+                "overhead_frac": round(overhead, 5),
+                "budget_frac": 0.02,
+            }
+
+            record["programs"] = prof.snapshot()
+            record["watermarks"] = memwatch().watermarks()
+        finally:
+            obs.finish_run(runlog)
+
+        # ---- evidence from the run log itself ----------------------
+        summary_programs = {}
+        program_events = 0
+        for ev in obs_events.read_events(scfg.obs_log):
+            if ev.get("event") == "program":
+                program_events += 1
+            if ev.get("event") == "summary":
+                summary_programs = ev.get("programs") or {}
+        caps_on_disk = [c for c in record["breach"]["captures"]
+                        if _dir_has_files(os.path.join(tmp, c))]
+        bundle_files = all(
+            os.path.exists(os.path.join(breach_dir, b, f))
+            for b in record["breach"]["flight_bundles"]
+            for f in ("bundle.json", "records.jsonl", "metrics.prom")
+        )
+
+        bench_rec = record["programs"].get("bench/step") or {}
+        serve_recs = [record["programs"][n]
+                      for n in record["serve"]["programs"]]
+        facts_keys = ("flops", "bytes_accessed", "arithmetic_intensity",
+                      "compile_s")
+        checks = {
+            "bench_registered": bool(bench_rec),
+            "serve_registered": bool(serve_recs),
+            "facts_complete": all(
+                r.get(k) is not None
+                for r in [bench_rec, *serve_recs] for k in facts_keys
+            ),
+            "mfu_gauge_parity_1pct": (record["bench"]["mfu_rel_err"]
+                                      is not None
+                                      and record["bench"]["mfu_rel_err"]
+                                      < 0.01),
+            "hbm_gauge_parity_1pct": (record["bench"]["hbm_rel_err"]
+                                      is not None
+                                      and record["bench"]["hbm_rel_err"]
+                                      < 0.01),
+            "p99_breach_fired": any(
+                a["name"] == "serve_p99" and a["state"] == "firing"
+                for a in record["breach"]["alerts"]),
+            "mfu_floor_breach_fired": any(
+                a["name"] == "serve_mfu" and a["state"] == "firing"
+                for a in record["breach"]["alerts"]),
+            "flight_bundle_written": bool(
+                record["breach"]["flight_bundles"]) and bundle_files,
+            "profiler_capture_written": bool(caps_on_disk),
+            "overhead_within_budget": (
+                record["overhead"]["overhead_frac"]
+                < record["overhead"]["budget_frac"]),
+            "runlog_has_program_events": program_events >= 2,
+            "runlog_summary_has_programs": "bench/step" in summary_programs,
+        }
+        record["checks"] = checks
+        record["ok"] = all(checks.values())
+    assert record["ok"], f"prof smoke failed: {record['checks']}"
+    return record
+
+
+def run_capture(seconds: float, out_dir: str) -> str:
+    """On-demand profiler capture: run the canonical bench step in a loop
+    for ~`seconds` under a device trace; returns the bundle path."""
+    import time
+
+    import jax
+
+    from multihop_offload_tpu.obs import prof as obs_prof
+
+    bench = _import_bench()
+    step, args, pad, batch, fp_path = _bench_step(bench)
+    compiled = step.lower(*args).compile()
+    jax.block_until_ready(compiled(*args))  # compile + warmup untraced
+
+    def body():
+        t_end = time.time() + max(float(seconds), 0.0)
+        out = compiled(*args)
+        while time.time() < t_end:
+            out = compiled(*args)
+        jax.block_until_ready(out)
+
+    return obs_prof.capture_trace(out_dir, fn=body)
+
+
+def render_peaks() -> str:
+    """Peak tables + this host's resolved peaks, as `mho-prof` prints."""
+    from multihop_offload_tpu.obs import prof as obs_prof
+
+    kind = obs_prof._device_kind()
+    lines = ["prof peaks (obs.prof; env overrides "
+             "MHO_PROF_PEAK_TFLOPS / MHO_PROF_PEAK_HBM_GBPS)"]
+    lines.append(f"  device_kind     {kind or '(unknown / no accelerator)'}")
+    lines.append(f"  peak_tflops     {obs_prof.peak_tflops(kind)}")
+    lines.append(f"  peak_hbm_gbps   {obs_prof.peak_hbm_gbps(kind)}")
+    lines.append("  table (device-kind substring -> bf16 TFLOP/s, HBM GB/s):")
+    hbm = dict(obs_prof.PEAK_HBM_GBPS_BY_KIND)
+    for sub, tf in obs_prof.PEAK_TFLOPS_BY_KIND:
+        lines.append(f"    {sub:<5} {tf:>7g} {hbm.get(sub, '-'):>7g}")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None):
+    from multihop_offload_tpu.cli.loop import write_record
+    from multihop_offload_tpu.utils.platform import apply_platform_env
+
+    p = build_parser()
+    p.add_argument("command", nargs="?", choices=["capture"],
+                   help="'capture' grabs an on-demand profiler trace of "
+                        "the bench step; default prints the peak tables")
+    p.add_argument("--smoke", action="store_true",
+                   help="prof drill (<90 s CPU): bench gauge/roofline "
+                        "parity, serve registration, injected SLO breach "
+                        "-> profiler capture + flight dump, accounting "
+                        "overhead budget; writes benchmarks/prof_smoke.json")
+    ns = p.parse_args(argv)
+    cfg = Config(**{f.name: getattr(ns, f.name)
+                    for f in dataclasses.fields(Config)})
+    apply_platform_env()
+
+    if ns.command == "capture":
+        out_dir = cfg.prof_out or "prof_trace"
+        path = run_capture(cfg.prof_seconds, out_dir)
+        if not path:
+            print("profiler capture failed (backend without profiler "
+                  "support, or a concurrent capture)", file=sys.stderr)
+            return 1
+        print(f"profiler trace bundle written to {path}")
+        return 0
+
+    if not ns.smoke:
+        print(render_peaks(), end="")
+        return 0
+
+    out = run_smoke(cfg)
+    path = cfg.prof_out or "benchmarks/prof_smoke.json"
+    write_record(out, path)
+    print(f"prof smoke record written to {path}")
+    print(json.dumps(out["checks"], indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -259,6 +259,13 @@ class Config:
     #                                attempt)
     chaos_out: str = ""            # write the chaos-smoke JSON record here
 
+    # --- performance observability (obs/prof, mho-prof) ---
+    prof_seconds: float = 1.0      # mho-prof capture: seconds of bench-step
+    #                                work to run under the profiler trace
+    prof_out: str = ""             # mho-prof: capture bundle dir (default
+    #                                prof_trace/) or smoke record path
+    #                                (default benchmarks/prof_smoke.json)
+
     @property
     def jnp_dtype(self):
         import jax.numpy as jnp

@@ -17,6 +17,7 @@ with `source="refit"` lineage; it never touches the serving tree — only
 from __future__ import annotations
 
 import os
+import time
 from typing import Optional, Sequence
 
 import jax
@@ -35,6 +36,7 @@ from multihop_offload_tpu.loop.experience import (
     pad_for_outcomes,
     replay_batches,
 )
+from multihop_offload_tpu.obs import prof as obs_prof
 from multihop_offload_tpu.obs import trace as obs_trace
 from multihop_offload_tpu.obs.registry import registry as obs_registry
 from multihop_offload_tpu.obs.spans import span
@@ -84,7 +86,6 @@ def refit(
 
     prob = cfg.prob
 
-    @jax.jit
     def step_fn(params, opt_state, binst, bjobs, keys):
         def one(inst, jb, k):
             out = forward_backward(
@@ -99,6 +100,10 @@ def refit(
         params = apply_max_norm_constraint(params, 1.0)
         return params, opt_state, jnp.mean(lc), jnp.mean(lm)
 
+    # registered per-program cost attribution: the refit step AOT-compiles
+    # on its first call and accounts each step's synced wall window
+    step_fn = obs_prof.wrap("loop/refit_step", jax.jit(step_fn))
+
     base_key = jax.random.PRNGKey(seed)
     losses = []
     with span("loop/refit", steps=steps, batches=len(batches)):
@@ -106,10 +111,13 @@ def refit(
             faults.crashpoint("refit:mid")
             binst, bjobs = batches[s % len(batches)]
             keys = jax.random.split(jax.random.fold_in(base_key, s), slots)
+            t0 = time.perf_counter()  # nondet-ok(device-time accounting is a measurement)
             params, opt_state, lc, lm = step_fn(
                 params, opt_state, binst, bjobs, keys
             )
             losses.append((float(lc), float(lm)))
+            # the float() pulls above are this loop's sync boundary
+            step_fn.account(time.perf_counter() - t0)  # nondet-ok(same measurement)
     obs_registry().counter(
         "mho_loop_refit_steps_total", "experience fine-tuning steps run"
     ).inc(steps)

@@ -16,6 +16,13 @@ request-scoped hop events through serve/sim/loop, `drift` watches the
 captured-experience stream for distribution shift, and `flightrec` keeps
 a bounded ring of tick diagnostics dumped as a debug bundle on breach
 (`mho-health` drives the closed-loop proof).
+
+The prof layer (`prof`, `memwatch`, `mho-prof`) adds per-program cost
+attribution: every jitted entry point registers its compiled program's
+AOT cost/memory analysis and accounts calls + device seconds, driving
+live MFU / HBM-fraction gauges against the peak-by-device-kind tables;
+`memwatch` tracks device-memory watermarks per phase; breach-triggered
+profiler captures land next to flight-recorder dumps.
 """
 
 from multihop_offload_tpu.obs.events import (  # noqa: F401
@@ -25,6 +32,19 @@ from multihop_offload_tpu.obs.events import (  # noqa: F401
     run_manifest,
     segment_paths,
     set_run_log,
+)
+from multihop_offload_tpu.obs.memwatch import (  # noqa: F401
+    MemWatch,
+    memwatch,
+)
+from multihop_offload_tpu.obs.prof import (  # noqa: F401
+    BreachCapture,
+    ProgramRegistry,
+    capture_trace,
+    peak_hbm_gbps,
+    peak_tflops,
+    prof_registry,
+    scan_corrected_flops,
 )
 from multihop_offload_tpu.obs.registry import (  # noqa: F401
     MetricRegistry,
@@ -62,16 +82,19 @@ def start_run(cfg, role: str):
 
 
 def finish_run(log, registry_=None) -> None:
-    """Close an enabled run log: record device-memory gauges, append the
-    summary event (phase-time table + full metric snapshot), optionally
-    dump the Prometheus exposition, and detach the active-sink slot."""
+    """Close an enabled run log: record device-memory gauges + a final
+    watermark snapshot, append the summary event (phase-time table, full
+    metric snapshot, per-program cost attribution), optionally dump the
+    Prometheus exposition, and detach the active-sink slot."""
     if log is None:
         return
     from multihop_offload_tpu.obs import jaxhooks
 
     jaxhooks.record_device_memory()
+    memwatch().snapshot("finish")
     reg = registry_ if registry_ is not None else registry()
-    log.summary(phases=phase_stats(), metrics=reg.snapshot())
+    log.summary(phases=phase_stats(), metrics=reg.snapshot(),
+                programs=prof_registry().snapshot())
     prom = getattr(log, "prom_path", None)
     if prom:
         with open(prom, "w") as f:

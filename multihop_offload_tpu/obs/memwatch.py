@@ -1,0 +1,119 @@
+"""Device-memory watermark tracking: per-phase snapshots + high-water events.
+
+`MemWatch.snapshot(phase)` reads each local device's allocator stats
+(best-effort: CPU and some backends return nothing) into
+
+    mho_device_mem_bytes{device=,stat=,phase=}
+
+gauges, and tracks a per-device high-water mark across snapshots: a new
+peak emits a ``watermark`` run-log event (device, bytes, phase), so the
+run log records *when* the footprint grew, not just the final number.
+Per-program peak scratch comes from the prof layer's `memory_analysis`
+(`mho_program_temp_bytes`) — together they answer "what is resident" and
+"which program needs the headroom".
+
+`stats_fn` is injectable for tests (and must be used instead of calling
+`device.memory_stats()` elsewhere — lint rule OB002 keeps attribution
+centralized in obs/)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional
+
+from multihop_offload_tpu.obs import events as obs_events
+from multihop_offload_tpu.obs.registry import (
+    MetricRegistry,
+    registry as _default_registry,
+)
+
+# the allocator stats worth a gauge each (when the backend reports them)
+_STATS = ("bytes_in_use", "peak_bytes_in_use", "largest_alloc_size")
+
+
+def _device_stats() -> Dict[str, dict]:
+    """{device-label: memory_stats dict} over local devices, best-effort."""
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:  # swallow-ok(a wedged backend must not kill the snapshot)
+        return {}
+    out = {}
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:  # swallow-ok(some backends have no allocator stats)
+            stats = None
+        if stats:
+            out[f"{d.platform}:{d.id}"] = stats
+    return out
+
+
+class MemWatch:
+    """Per-phase device-memory snapshots with high-water tracking."""
+
+    def __init__(self, registry: Optional[MetricRegistry] = None,
+                 stats_fn: Callable[[], Dict[str, dict]] = _device_stats):
+        self._registry = registry
+        self._stats_fn = stats_fn
+        self._lock = threading.Lock()
+        self._high_water: Dict[str, float] = {}
+
+    def _reg(self) -> MetricRegistry:
+        return self._registry if self._registry is not None \
+            else _default_registry()
+
+    def snapshot(self, phase: str = "") -> Dict[str, dict]:
+        """Record one snapshot; returns {device: {stat: bytes}} actually
+        read (empty on backends without allocator stats — never raises)."""
+        try:
+            per_device = self._stats_fn() or {}
+        except Exception:  # swallow-ok(watermarks are diagnostic, never fatal)
+            return {}
+        gauge = self._reg().gauge(
+            "mho_device_mem_bytes",
+            "device allocator stats per phase snapshot",
+        )
+        out: Dict[str, dict] = {}
+        for device, stats in per_device.items():
+            rec = {}
+            for stat in _STATS:
+                v = stats.get(stat)
+                if v is None:
+                    continue
+                rec[stat] = int(v)
+                gauge.set(float(v), device=device, stat=stat,
+                          **({"phase": phase} if phase else {}))
+            if not rec:
+                continue
+            out[device] = rec
+            mark = float(rec.get("peak_bytes_in_use",
+                                 rec.get("bytes_in_use", 0)))
+            with self._lock:
+                prev = self._high_water.get(device, 0.0)
+                is_new_peak = mark > prev
+                if is_new_peak:
+                    self._high_water[device] = mark
+            if is_new_peak:
+                obs_events.emit("watermark", device=device,
+                                bytes=int(mark), phase=phase)
+        return out
+
+    def watermarks(self) -> Dict[str, int]:
+        """Per-device high-water bytes seen across all snapshots."""
+        with self._lock:
+            return {d: int(v) for d, v in self._high_water.items()}
+
+
+_DEFAULT = MemWatch()
+
+
+def memwatch() -> MemWatch:
+    """The process-wide default watcher the entry points share."""
+    return _DEFAULT
+
+
+def snapshot(phase: str = "") -> Dict[str, dict]:
+    """Convenience: snapshot through the default watcher."""
+    return _DEFAULT.snapshot(phase)

@@ -1,0 +1,555 @@
+"""Always-on per-program performance observability (the prof layer).
+
+Every jitted entry point (serve BucketExecutor buckets, the train step,
+the sim FleetSim scan, the flywheel refit step, bench) registers its
+compiled program here at build time.  Registration captures the AOT
+`cost_analysis` / `memory_analysis` view — flops, bytes accessed,
+argument/temp bytes, compile wall time — with the scan-interior FLOP
+correction (factored out of `bench.py`) applied per program; accounting
+calls record invocation counts and block-until-ready device seconds.
+Together they drive the live counters
+
+    mho_program_flops_total{program=}          corrected flops executed
+    mho_program_bytes_total{program=}          HBM bytes accessed
+    mho_program_calls_total{program=}          program invocations
+    mho_program_device_seconds_total{program=} accounted device wall time
+
+and the continuous utilization gauges
+
+    mho_program_mfu{program=}         cumulative corrected-flop rate / peak
+    mho_program_hbm_frac{program=}    cumulative byte rate / peak HBM BW
+
+against the peak-by-device-kind tables (moved here from `bench.py` — the
+chip spec numbers MFU is conventionally quoted against; unknown kinds set
+no gauge rather than invent a denominator).  `MHO_PROF_PEAK_TFLOPS` /
+`MHO_PROF_PEAK_HBM_GBPS` override the table (the CPU smoke drills gauge
+math against a fake peak).
+
+`capture_trace` wraps `jax.profiler` start/stop into a never-raising
+Perfetto/TensorBoard trace bundle (`mho-prof capture`), and
+`BreachCapture` hooks it to the SLO engine so a `serve_p99` / `serve_mfu`
+breach grabs a short device trace next to the flight-recorder dump.
+
+Cost/memory introspection is centralized here (and in `bench.py`): direct
+`cost_analysis()` / `memory_analysis()` / `memory_stats()` calls anywhere
+else are flagged by lint rule OB002 unless waived with `# prof-ok(<why>)`.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+import time
+from typing import Callable, Dict, Optional, Sequence
+
+from multihop_offload_tpu.obs import events as obs_events
+from multihop_offload_tpu.obs.registry import (
+    MetricRegistry,
+    registry as _default_registry,
+)
+
+# ---- peak-by-device-kind tables (moved from bench.py) ----------------------
+
+# Peak dense-matmul throughput per chip (bf16 MXU, the number TPU MFU is
+# conventionally quoted against), by `jax.devices()[0].device_kind`
+# substring.  Sources: published TPU spec sheets; unknown kinds report
+# None rather than invent a denominator.
+PEAK_TFLOPS_BY_KIND = (
+    ("v6", 918.0),   # Trillium
+    ("v5p", 459.0),
+    ("v5e", 197.0),  # v5 lite
+    ("v5", 197.0),
+    ("v4", 275.0),
+    ("v3", 123.0),
+    ("v2", 46.0),
+)
+
+# Published HBM bandwidth per chip (GB/s), same substring lookup.  The
+# repo's step is bandwidth-bound (BENCH_r05: arithmetic intensity ~0.117),
+# so the fraction of peak HBM is the honest utilization number, not MFU.
+PEAK_HBM_GBPS_BY_KIND = (
+    ("v6", 1640.0),  # Trillium
+    ("v5p", 2765.0),
+    ("v5e", 819.0),
+    ("v5", 819.0),
+    ("v4", 1228.0),
+    ("v3", 900.0),
+    ("v2", 700.0),
+)
+
+
+def _env_peak(name: str) -> Optional[float]:
+    raw = os.environ.get(name, "")
+    try:
+        v = float(raw)
+        return v if v > 0 else None
+    except ValueError:
+        return None
+
+
+def peak_tflops(device_kind: str) -> Optional[float]:
+    """Peak bf16 TFLOP/s for a device kind; `MHO_PROF_PEAK_TFLOPS`
+    overrides (the CPU smoke's fake peak), unknown kinds return None."""
+    override = _env_peak("MHO_PROF_PEAK_TFLOPS")
+    if override is not None:
+        return override
+    kind = (device_kind or "").lower()
+    for sub, peak in PEAK_TFLOPS_BY_KIND:
+        if sub in kind:
+            return peak
+    return None
+
+
+def peak_hbm_gbps(device_kind: str) -> Optional[float]:
+    """Peak HBM GB/s for a device kind; `MHO_PROF_PEAK_HBM_GBPS`
+    overrides, unknown kinds return None."""
+    override = _env_peak("MHO_PROF_PEAK_HBM_GBPS")
+    if override is not None:
+        return override
+    kind = (device_kind or "").lower()
+    for sub, peak in PEAK_HBM_GBPS_BY_KIND:
+        if sub in kind:
+            return peak
+    return None
+
+
+# ---- the scan-interior FLOP correction (moved from bench.py) ---------------
+
+def scan_corrected_flops(ca_flops: float, pad_n: int, pad_l: int, batch: int,
+                         fp_iters: int = 10, fp_sites: int = 5,
+                         fp_path: str = "xla") -> float:
+    """XLA cost_analysis charges fori_loop/scan/while bodies ONCE
+    (measured: benchmarks/flops_reconcile.json — the 7-iteration APSP
+    compiles to the same flop count as 1 iteration, and one APSP iteration
+    matches the analytic 2N^3*B within 1%).  MFU therefore uses this
+    corrected count: cost_analysis plus the (iters-1) uncharged APSP
+    squarings plus the uncharged fixed-point work at each of the step's ~5
+    fixed-point call sites.  The fixed-point term depends on which kernel
+    compiled in: the XLA scan has its body charged once (add fp_iters-1
+    passes); the Pallas kernel lowers to a custom call whose interior
+    cost_analysis does not see at all (add all fp_iters passes)."""
+    apsp_iters = max(1, math.ceil(math.log2(max(pad_n - 1, 2))))
+    apsp_extra = (apsp_iters - 1) * 2.0 * batch * pad_n**3
+    fp_uncharged = fp_iters if fp_path == "pallas" else fp_iters - 1
+    fp_extra = fp_sites * fp_uncharged * 2.0 * batch * pad_l**2
+    return ca_flops + apsp_extra + fp_extra
+
+
+# ---- the program registry --------------------------------------------------
+
+class ProgramRecord:
+    """Per-program cost/memory facts plus cumulative usage counters."""
+
+    __slots__ = ("name", "flops", "flops_corrected", "bytes_accessed",
+                 "argument_bytes", "temp_bytes", "compile_s", "compiles",
+                 "calls", "device_s")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.flops: Optional[float] = None
+        self.flops_corrected: Optional[float] = None
+        self.bytes_accessed: Optional[float] = None
+        self.argument_bytes: Optional[float] = None
+        self.temp_bytes: Optional[float] = None
+        self.compile_s: Optional[float] = None
+        self.compiles = 0
+        self.calls = 0
+        self.device_s = 0.0
+
+    def to_json(self) -> dict:
+        ai = (round(self.flops_corrected / self.bytes_accessed, 4)
+              if self.flops_corrected and self.bytes_accessed else None)
+        return {
+            "flops": self.flops,
+            "flops_corrected": self.flops_corrected,
+            "bytes_accessed": self.bytes_accessed,
+            "argument_bytes": self.argument_bytes,
+            "temp_bytes": self.temp_bytes,
+            "arithmetic_intensity": ai,
+            "compile_s": self.compile_s,
+            "compiles": self.compiles,
+            "calls": self.calls,
+            "device_s": round(self.device_s, 6),
+        }
+
+
+def extract_cost(compiled) -> dict:
+    """Best-effort AOT cost/memory view of a compiled executable:
+    {flops, bytes_accessed, argument_bytes, temp_bytes} (values None when
+    the backend does not report them).  Never raises — cost analysis is
+    diagnostic, and some backends (or a fallback-to-jit path) lack it."""
+    out = {"flops": None, "bytes_accessed": None,
+           "argument_bytes": None, "temp_bytes": None}
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if ca:
+            out["flops"] = float(ca.get("flops", 0.0)) or None
+            out["bytes_accessed"] = float(ca.get("bytes accessed", 0.0)) or None
+    except Exception:  # swallow-ok(cost analysis is diagnostic, never fatal)
+        pass
+    try:
+        mem = compiled.memory_analysis()
+        if mem is not None:
+            out["argument_bytes"] = float(
+                getattr(mem, "argument_size_in_bytes", 0.0)) or None
+            out["temp_bytes"] = float(
+                getattr(mem, "temp_size_in_bytes", 0.0)) or None
+    except Exception:  # swallow-ok(memory analysis is diagnostic, never fatal)
+        pass
+    return out
+
+
+def _device_kind() -> str:
+    try:
+        import jax
+
+        devs = jax.devices()
+        return getattr(devs[0], "device_kind", "") if devs else ""
+    except Exception:  # swallow-ok(a wedged backend must not kill accounting)
+        return ""
+
+
+class ProgramRegistry:
+    """Process-wide per-program cost attribution (see module doc).
+
+    `register` is idempotent per name: a re-register (hot-reload rebuild,
+    bucket recompile) refreshes the cost/memory facts and bumps the
+    compile count but preserves the cumulative call/device-time counters.
+    Peaks are injectable for tests; by default they resolve lazily from
+    the device kind (plus the env overrides)."""
+
+    def __init__(self, registry: Optional[MetricRegistry] = None,
+                 peak_tflops_: Optional[float] = None,
+                 peak_hbm_gbps_: Optional[float] = None):
+        self._registry = registry
+        self._peak_tflops = peak_tflops_
+        self._peak_hbm = peak_hbm_gbps_
+        self._peaks_resolved = (peak_tflops_ is not None
+                                or peak_hbm_gbps_ is not None)
+        self._lock = threading.RLock()
+        self._programs: Dict[str, ProgramRecord] = {}
+
+    def _reg(self) -> MetricRegistry:
+        return self._registry if self._registry is not None \
+            else _default_registry()
+
+    def _peaks(self):
+        """(peak_tflops, peak_hbm_gbps), resolved once from the device kind
+        unless injected at construction."""
+        if not self._peaks_resolved:
+            kind = _device_kind()
+            self._peak_tflops = peak_tflops(kind)
+            self._peak_hbm = peak_hbm_gbps(kind)
+            self._peaks_resolved = True
+        return self._peak_tflops, self._peak_hbm
+
+    # ---- build-time ----------------------------------------------------
+
+    def register(self, name: str, compiled=None, *,
+                 compile_s: Optional[float] = None,
+                 correction: Optional[Callable[[float], float]] = None,
+                 flops: Optional[float] = None,
+                 bytes_accessed: Optional[float] = None,
+                 argument_bytes: Optional[float] = None,
+                 temp_bytes: Optional[float] = None) -> ProgramRecord:
+        """Record one compiled program's cost/memory facts.  `compiled` is
+        an AOT executable (cost extracted here, inside obs/); explicit
+        keyword facts override extraction (tests, hand counts).
+        `correction` maps raw cost-analysis flops to the corrected count
+        (see `scan_corrected_flops`); None means raw == corrected."""
+        facts = extract_cost(compiled) if compiled is not None else {}
+        with self._lock:
+            rec = self._programs.get(name)
+            if rec is None:
+                rec = self._programs[name] = ProgramRecord(name)
+            rec.compiles += 1
+            rec.flops = flops if flops is not None else facts.get("flops")
+            rec.bytes_accessed = (bytes_accessed if bytes_accessed is not None
+                                  else facts.get("bytes_accessed"))
+            rec.argument_bytes = (argument_bytes if argument_bytes is not None
+                                  else facts.get("argument_bytes"))
+            rec.temp_bytes = (temp_bytes if temp_bytes is not None
+                              else facts.get("temp_bytes"))
+            if rec.flops is not None:
+                try:
+                    rec.flops_corrected = float(
+                        correction(rec.flops) if correction else rec.flops)
+                except Exception:  # swallow-ok(a broken correction degrades to the raw count)
+                    rec.flops_corrected = rec.flops
+            else:
+                rec.flops_corrected = None
+            if compile_s is not None:
+                rec.compile_s = float(compile_s)
+        reg = self._reg()
+        if rec.compile_s is not None:
+            reg.gauge(
+                "mho_program_compile_seconds",
+                "last AOT compile wall time per program",
+            ).set(round(rec.compile_s, 6), program=name)
+        if rec.flops_corrected and rec.bytes_accessed:
+            reg.gauge(
+                "mho_program_arithmetic_intensity",
+                "corrected flops / bytes accessed per program",
+            ).set(round(rec.flops_corrected / rec.bytes_accessed, 4),
+                  program=name)
+        if rec.temp_bytes is not None:
+            reg.gauge(
+                "mho_program_temp_bytes",
+                "XLA temp allocation per program (peak scratch)",
+            ).set(rec.temp_bytes, program=name)
+        obs_events.emit("program", name=name, **rec.to_json())
+        return rec
+
+    # ---- run-time ------------------------------------------------------
+
+    def account(self, name: str, device_s: float, calls: int = 1) -> None:
+        """Account `calls` invocations of `name` covering `device_s` of
+        block-until-ready wall time (measured at the call site's natural
+        sync boundary).  Unregistered names accumulate calls/time only."""
+        with self._lock:
+            rec = self._programs.get(name)
+            if rec is None:
+                rec = self._programs[name] = ProgramRecord(name)
+            rec.calls += int(calls)
+            rec.device_s += float(device_s)
+            flops = rec.flops_corrected
+            bytes_ = rec.bytes_accessed
+            total_s = rec.device_s
+        reg = self._reg()
+        reg.counter(
+            "mho_program_calls_total", "program invocations"
+        ).inc(calls, program=name)
+        reg.counter(
+            "mho_program_device_seconds_total",
+            "accounted device wall seconds per program",
+        ).inc(max(float(device_s), 0.0), program=name)
+        if flops:
+            reg.counter(
+                "mho_program_flops_total", "corrected flops executed"
+            ).inc(flops * calls, program=name)
+        if bytes_:
+            reg.counter(
+                "mho_program_bytes_total", "HBM bytes accessed"
+            ).inc(bytes_ * calls, program=name)
+        if total_s <= 0:
+            return
+        peak_tf, peak_bw = self._peaks()
+        with self._lock:
+            total_calls = rec.calls
+        if flops and peak_tf:
+            mfu = (flops * total_calls / total_s) / (peak_tf * 1e12)
+            reg.gauge(
+                "mho_program_mfu",
+                "cumulative corrected-flop rate over peak bf16 matmul",
+            ).set(round(mfu, 6), program=name)
+        if bytes_ and peak_bw:
+            frac = (bytes_ * total_calls / total_s) / (peak_bw * 1e9)
+            reg.gauge(
+                "mho_program_hbm_frac",
+                "cumulative byte rate over peak HBM bandwidth",
+            ).set(round(frac, 6), program=name)
+
+    # ---- export --------------------------------------------------------
+
+    def get(self, name: str) -> Optional[ProgramRecord]:
+        with self._lock:
+            return self._programs.get(name)
+
+    def names(self) -> list:
+        with self._lock:
+            return sorted(self._programs)
+
+    def snapshot(self) -> dict:
+        """{name: record-dict} — the run-log summary embeds this as
+        `programs=` and `mho-obs` renders it as the performance table."""
+        with self._lock:
+            return {name: rec.to_json()
+                    for name, rec in sorted(self._programs.items())}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._programs.clear()
+
+
+_DEFAULT = ProgramRegistry()
+
+
+def prof_registry() -> ProgramRegistry:
+    """The process-wide default program registry the wired entry points
+    (serve/sim/train/loop/bench) share."""
+    return _DEFAULT
+
+
+# ---- AOT wrap helper -------------------------------------------------------
+
+class ProfiledProgram:
+    """A jitted callable that AOT-compiles on first call and registers.
+
+    The first invocation lowers and compiles ahead of time (timed — that
+    wall time IS the registered compile_s), registers the executable's
+    cost/memory facts under `name`, and dispatches through the compiled
+    object from then on (the AOT and jit caches are separate; reusing the
+    executable avoids paying XLA twice).  If AOT lowering fails (backend
+    without support, donated-buffer quirks) the wrapper falls back to the
+    plain jitted callable and registers with whatever facts it has — the
+    entry point keeps working, it just loses cost attribution.
+
+    Accounting stays at the call site's natural sync boundary: call
+    `account(device_s, calls)` after the block/fetch that completes the
+    dispatch — per-call forced blocking here would serialize pipelined
+    loops and blow the <2% obs overhead budget.
+    """
+
+    def __init__(self, name: str, jitted: Callable, *,
+                 prof: Optional[ProgramRegistry] = None,
+                 correction: Optional[Callable[[float], float]] = None):
+        self.name = name
+        self._jitted = jitted
+        self._fn: Optional[Callable] = None
+        self._prof = prof if prof is not None else prof_registry()
+        self._correction = correction
+        self._lock = threading.Lock()
+        self._pending_compile_s = 0.0
+
+    def _build(self, args, kwargs):
+        t0 = time.perf_counter()  # nondet-ok(compile wall time is a measurement)
+        try:
+            compiled = self._jitted.lower(*args, **kwargs).compile()
+        except Exception:  # swallow-ok(AOT is an optimization; the jitted fallback keeps serving)
+            compiled = None
+        dt = time.perf_counter() - t0  # nondet-ok(same measurement)
+        self._pending_compile_s = dt
+        if compiled is not None:
+            self._prof.register(self.name, compiled, compile_s=dt,
+                                correction=self._correction)
+            return compiled
+        self._prof.register(self.name, compile_s=dt,
+                            correction=self._correction)
+        return self._jitted
+
+    def __call__(self, *args, **kwargs):
+        fn = self._fn
+        if fn is None:
+            with self._lock:
+                if self._fn is None:
+                    self._fn = self._build(args, kwargs)
+                fn = self._fn
+        if fn is self._jitted:
+            return fn(*args, **kwargs)
+        try:
+            return fn(*args, **kwargs)
+        except (TypeError, ValueError):
+            # the AOT executable is pinned to the first call's shapes; a
+            # caller that legitimately changes shapes (per-file pads in the
+            # trainer) drops back to the jit cache, which retraces — and
+            # the jaxhooks steady-state gate still polices whether that
+            # retrace was expected
+            with self._lock:
+                self._fn = self._jitted
+            return self._jitted(*args, **kwargs)
+
+    def account(self, device_s: float, calls: int = 1) -> None:
+        """Account a sync-boundary wall window.  The window around the
+        FIRST call contains the AOT compile (reported separately as
+        compile_s), so that much is deducted once — the device-seconds
+        counter tracks execution, not build."""
+        with self._lock:
+            pending, self._pending_compile_s = self._pending_compile_s, 0.0
+        self._prof.account(self.name, max(float(device_s) - pending, 0.0),
+                           calls=calls)
+
+
+def wrap(name: str, jitted: Callable, *,
+         prof: Optional[ProgramRegistry] = None,
+         correction: Optional[Callable[[float], float]] = None) -> ProfiledProgram:
+    """Wrap a `jax.jit` callable as a registered, AOT-compiled program."""
+    return ProfiledProgram(name, jitted, prof=prof, correction=correction)
+
+
+# ---- profiler capture ------------------------------------------------------
+
+def capture_trace(out_dir: str, duration_s: float = 0.0,
+                  fn: Optional[Callable[[], None]] = None) -> str:
+    """Grab a device profiler trace (Perfetto / TensorBoard profile
+    plugin) into `out_dir`: start the trace, run `fn()` when given (else
+    idle-wait `duration_s`), stop.  Never raises — on backends without
+    profiler support (or a second concurrent capture) the failure is a
+    counter and an empty return, not a dead serving tick."""
+    try:
+        import jax
+
+        os.makedirs(out_dir, exist_ok=True)
+        jax.profiler.start_trace(out_dir)
+        try:
+            if fn is not None:
+                fn()
+            elif duration_s > 0:
+                time.sleep(float(duration_s))
+        finally:
+            jax.profiler.stop_trace()
+    except Exception as exc:  # swallow-ok(profiler capture is best-effort by contract)
+        _default_registry().counter(
+            "mho_prof_capture_failures_total",
+            "profiler captures that failed to start or stop",
+        ).inc()
+        obs_events.emit("prof_capture", path="", error=str(exc)[:200])
+        return ""
+    _default_registry().counter(
+        "mho_prof_captures_total", "profiler trace bundles captured"
+    ).inc()
+    obs_events.emit("prof_capture", path=out_dir,
+                    duration_s=round(float(duration_s), 6))
+    return out_dir
+
+
+class BreachCapture:
+    """SLO-breach-triggered profiler capture, companion to FlightRecorder.
+
+    Register `on_breach` with the SLO engine; a firing transition of one
+    of the watched SLOs grabs a short device trace into
+    ``<out_dir>/capture-NNN-<slo>/`` — numbered like flight bundles so the
+    trace lands next to the dump that describes the same incident.  The
+    engine already fires once per ok->firing transition, so each breach
+    captures exactly once; `min_interval_s` adds a cooldown on top for
+    flapping alerts.  `tracer` is injectable (tests; the default is
+    `capture_trace`, which never raises)."""
+
+    def __init__(self, out_dir: str,
+                 slos: Sequence[str] = ("serve_p99", "serve_mfu"),
+                 duration_s: float = 0.05,
+                 clock: Callable[[], float] = time.time,
+                 min_interval_s: float = 0.0,
+                 tracer: Callable[..., str] = capture_trace,
+                 fn: Optional[Callable[[], None]] = None):
+        self.out_dir = out_dir
+        self.slos = tuple(slos)
+        self.duration_s = float(duration_s)
+        self.clock = clock
+        self.min_interval_s = float(min_interval_s)
+        self.tracer = tracer
+        self.fn = fn
+        self.captures: list = []
+        self._seq = 0
+        self._last_at: Optional[float] = None
+
+    def on_breach(self, spec, info: dict) -> str:
+        """The SLO engine's breach callback; returns the bundle path
+        (empty when the SLO is not watched, cooled down, or capture
+        failed)."""
+        name = getattr(spec, "name", str(spec))
+        if name not in self.slos:
+            return ""
+        now = float(self.clock())
+        if (self._last_at is not None
+                and now - self._last_at < self.min_interval_s):
+            return ""
+        self._last_at = now
+        self._seq += 1
+        bundle = os.path.join(self.out_dir, f"capture-{self._seq:03d}-{name}")
+        path = self.tracer(bundle, self.duration_s, self.fn)
+        if path:
+            self.captures.append(path)
+        return path

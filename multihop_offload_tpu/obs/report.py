@@ -32,8 +32,10 @@ def classify_phase(name: str) -> str:
 
 # low-volume health events retained in full by load_run (an alert history
 # is only useful complete); absent in pre-health logs — every consumer
-# degrades to "no section" on an empty list
-_HEALTH_EVENTS = ("alert", "drift", "flight_record")
+# degrades to "no section" on an empty list.  watermark / prof_capture are
+# the prof layer's additions (memory high-water marks, profiler bundles)
+_HEALTH_EVENTS = ("alert", "drift", "flight_record", "watermark",
+                  "prof_capture")
 
 
 def load_run(path: str) -> dict:
@@ -42,6 +44,7 @@ def load_run(path: str) -> dict:
     counts: Dict[str, int] = {}
     phases: Dict[str, dict] = {}
     metrics: Dict[str, dict] = {}
+    programs: Dict[str, dict] = {}
     last_of: Dict[str, dict] = {}
     health: Dict[str, List[dict]] = {k: [] for k in _HEALTH_EVENTS}
     first_ts = last_ts = None
@@ -72,6 +75,9 @@ def load_run(path: str) -> dict:
             for name, s in (ev.get("phases") or {}).items():
                 phases[name] = dict(s)
             metrics = ev.get("metrics") or metrics
+            # prof-layer snapshot ({program: facts}); absent in pre-prof
+            # logs — consumers degrade to "no performance section"
+            programs = ev.get("programs") or programs
     for p in phases.values():
         p.setdefault("mean_s", p["total_s"] / max(p.get("count", 1), 1))
     return {
@@ -79,6 +85,7 @@ def load_run(path: str) -> dict:
         "counts": counts,
         "phases": phases,
         "metrics": metrics,
+        "programs": programs,
         "last": last_of,
         "health": health,
         "wall_s": (last_ts - first_ts) if first_ts is not None else None,
@@ -99,6 +106,30 @@ def _counter_by_label(metrics: dict, name: str) -> Dict[str, float]:
         return {}
     return {k or "(total)": float(v) for k, v in m["series"].items()
             if isinstance(v, (int, float))}
+
+
+def _fmt_opt(v, fmt: str) -> str:
+    """Format an optional numeric cell; None (backend did not report the
+    fact) renders as '-'."""
+    return fmt.format(float(v)) if isinstance(v, (int, float)) else "-"
+
+
+def _program_gauge(metrics: dict, name: str) -> Dict[str, float]:
+    """{program: value} from a per-program gauge's summary snapshot."""
+    m = metrics.get(name)
+    out: Dict[str, float] = {}
+    for labels, v in ((m or {}).get("series") or {}).items():
+        if not isinstance(v, (int, float)):
+            continue
+        # label strings render as {program="name"} (registry convention)
+        key = str(labels)
+        pre = 'program="'
+        i = key.find(pre)
+        if i >= 0:
+            j = key.find('"', i + len(pre))
+            if j > 0:
+                out[key[i + len(pre):j]] = float(v)
+    return out
 
 
 def _fmt_row(cells: Iterable[str], widths: List[int]) -> str:
@@ -175,6 +206,51 @@ def render_report(path: str) -> str:
         for lab, v in sorted(by_phase.items(), key=lambda kv: -kv[1]):
             lines.append(f"    {lab} {int(v)}")
     lines.append("")
+
+    # prof layer: per-program cost/MFU attribution (summary `programs=`
+    # snapshot + the live utilization gauges).  Pre-prof logs have neither
+    # — the section is omitted, not rendered empty.
+    programs = run.get("programs") or {}
+    if programs:
+        lines.append("performance (per program)")
+        mfu = _program_gauge(metrics, "mho_program_mfu")
+        hbm = _program_gauge(metrics, "mho_program_hbm_frac")
+        rows = []
+        for name in sorted(programs):
+            p = programs[name]
+            rows.append([
+                name,
+                p.get("calls", 0),
+                _fmt_opt(p.get("device_s"), "{:.3f}"),
+                _fmt_opt(p.get("compile_s"), "{:.2f}"),
+                _fmt_opt(p.get("flops_corrected"), "{:.3e}"),
+                _fmt_opt(p.get("bytes_accessed"), "{:.3e}"),
+                _fmt_opt(p.get("arithmetic_intensity"), "{:.3f}"),
+                _fmt_opt(mfu.get(name), "{:.4f}"),
+                _fmt_opt(hbm.get(name), "{:.4f}"),
+            ])
+        lines += ["  " + ln for ln in _table(
+            ["program", "calls", "device_s", "compile_s", "flops",
+             "bytes", "AI", "mfu", "hbm_frac"], rows)]
+        lines.append("")
+    watermarks = (run.get("health") or {}).get("watermark") or []
+    captures = (run.get("health") or {}).get("prof_capture") or []
+    if watermarks or captures:
+        lines.append("memory watermarks & profiler captures")
+        seen: Dict[str, dict] = {}
+        for w in watermarks:  # keep only each device's final high-water mark
+            seen[str(w.get("device", "?"))] = w
+        for dev, w in sorted(seen.items()):
+            lines.append(
+                f"  watermark {dev:<14} {int(w.get('bytes', 0))} bytes"
+                + (f" (phase {w['phase']})" if w.get("phase") else "")
+            )
+        for c in captures:
+            lines.append(
+                f"  profiler capture: {c.get('path') or '(failed)'}"
+                + (f" — {c['error']}" if c.get("error") else "")
+            )
+        lines.append("")
 
     serve_counters = {
         name: _counter_by_label(metrics, name) for name in metrics

@@ -27,6 +27,10 @@ Spec kinds (what `_sample` reads):
                    ratio / drop rate
     gauge_max      synthesized series: each observe() contributes total += 1
                    and good += 1 iff gauge <= `bound` — queue depth
+    gauge_min      the mirror: good += 1 iff the gauge is >= `bound`
+                   (worst series across label sets; an unset gauge is
+                   good — no data is not a breach) — the `serve_mfu`
+                   utilization floor over `mho_program_mfu{program=}`
     counter_zero   total += 1 per observe, good += 1 iff the counter did
                    not move since the previous observe — the
                    `jax_unexpected_retraces_total == 0` invariant (its
@@ -55,7 +59,7 @@ from multihop_offload_tpu.obs.registry import (
     registry as _default_registry,
 )
 
-KINDS = ("histogram_le", "ratio", "gauge_max", "counter_zero")
+KINDS = ("histogram_le", "ratio", "gauge_max", "gauge_min", "counter_zero")
 
 _LabelPairs = Tuple[Tuple[str, str], ...]
 
@@ -69,7 +73,7 @@ class SLOSpec:
     metric: str
     objective: float                      # target good fraction in (0, 1]
     le: float = 0.0                       # histogram_le: the latency bound
-    bound: float = 0.0                    # gauge_max: the gauge ceiling
+    bound: float = 0.0                    # gauge_max: ceiling / gauge_min: floor
     total_metric: str = ""                # ratio: denominator counter
     labels: _LabelPairs = ()              # ratio: numerator label filter
     total_labels: _LabelPairs = ()        # ratio: denominator label filter
@@ -95,11 +99,16 @@ def default_serving_slos(
     admit_objective: float = 0.90,
     queue_bound: float = 48.0,
     queue_objective: float = 0.99,
+    mfu_floor: float = 0.0,
+    mfu_objective: float = 0.95,
 ) -> List[SLOSpec]:
-    """The serving SLO set the issue names: p99 tick latency, delivered
-    ratio, drop rate, queue depth, and the zero-unexpected-retrace
-    invariant."""
-    return [
+    """The serving SLO set: p99 tick latency, delivered ratio, drop rate,
+    queue depth, and the zero-unexpected-retrace invariant.  `mfu_floor`
+    > 0 adds `serve_mfu` — a utilization-regression alert over the prof
+    layer's live `mho_program_mfu` gauges (worst program must stay at or
+    above the floor); off by default because the honest floor is
+    per-device-kind and set from a committed bench roofline."""
+    specs = [
         SLOSpec(
             "serve_p99", "histogram_le", "mho_serve_latency_seconds",
             objective=latency_objective, le=latency_le,
@@ -130,6 +139,13 @@ def default_serving_slos(
             description="no recompiles after steady state",
         ),
     ]
+    if mfu_floor > 0.0:
+        specs.append(SLOSpec(
+            "serve_mfu", "gauge_min", "mho_program_mfu",
+            objective=mfu_objective, bound=mfu_floor,
+            description=f"per-program MFU >= {mfu_floor:g}",
+        ))
+    return specs
 
 
 class _Series:
@@ -208,6 +224,19 @@ class SLOEngine:
             v = m.value() if isinstance(m, Gauge) else None
             st.synth_total += 1
             st.synth_good += int(v is None or float(v) <= spec.bound)
+            return float(st.synth_good), float(st.synth_total)
+        if spec.kind == "gauge_min":
+            # worst (minimum) value across every label set: any one
+            # program falling under the floor is a bad sample; no data at
+            # all is good (an idle service is not a utilization breach)
+            m = self.registry._metrics.get(spec.metric)
+            v = None
+            if isinstance(m, Gauge):
+                with m._lock:
+                    vals = [float(x) for x in m._series.values()]
+                v = min(vals) if vals else None
+            st.synth_total += 1
+            st.synth_good += int(v is None or v >= spec.bound)
             return float(st.synth_good), float(st.synth_total)
         # counter_zero: good sample iff the counter did not move
         cur = self._counter_total(spec.metric, ())

@@ -18,6 +18,7 @@ requests.
 from __future__ import annotations
 
 import os
+import time
 from typing import Optional
 
 import jax
@@ -25,6 +26,7 @@ import numpy as np
 
 from multihop_offload_tpu.agent.policy import forward_env
 from multihop_offload_tpu.env.policies import baseline_policy
+from multihop_offload_tpu.obs import prof as obs_prof
 from multihop_offload_tpu.obs import trace as obs_trace
 from multihop_offload_tpu.serve.bucketing import ShapeBuckets
 from multihop_offload_tpu.train import checkpoints as ckpt_lib
@@ -101,9 +103,18 @@ class BucketExecutor:
 
                 return jax.vmap(one)(binst, bjobs, keys)
 
+            # each bucket program registers with the prof layer on its
+            # first dispatch (AOT compile + cost/memory analysis); the
+            # compiled executable then serves every later tick
             self._steps[b] = (
-                jax.jit(gnn_step),  # retrace-ok(one program per bucket, built once at construction)
-                jax.jit(baseline_step),  # retrace-ok(same: the loop IS the build)
+                obs_prof.wrap(
+                    f"serve/bucket{b}/gnn",
+                    jax.jit(gnn_step),  # retrace-ok(one program per bucket, built once at construction)
+                ),
+                obs_prof.wrap(
+                    f"serve/bucket{b}/baseline",
+                    jax.jit(baseline_step),  # retrace-ok(same: the loop IS the build)
+                ),
             )
 
     def run(self, bucket: int, binst, bjobs, keys, degraded: bool = False,
@@ -113,6 +124,8 @@ class BucketExecutor:
         `request_ids` (when the service traces) stamps the batch with a
         ``dispatch`` hop — which program ran, on which weights."""
         gnn, baseline = self._steps[bucket]
+        step = baseline if degraded else gnn
+        t0 = time.perf_counter()  # nondet-ok(device-time accounting is a measurement)
         out = (baseline(binst, bjobs, keys) if degraded
                else gnn(self.variables, binst, bjobs, keys))
         self.dispatch_count += 1
@@ -123,7 +136,11 @@ class BucketExecutor:
                 program="baseline" if degraded else "gnn",
                 step=self.loaded_step,
             )
-        return tuple(np.asarray(x) for x in jax.device_get(out))
+        host = tuple(np.asarray(x) for x in jax.device_get(out))
+        # the bulk fetch above IS the sync boundary: dispatch-to-fetch wall
+        # time is this program's device window
+        step.account(time.perf_counter() - t0)  # nondet-ok(same measurement)
+        return host
 
     def hot_reload(self, model_dir: str, which: str = "orbax") -> Optional[int]:
         """Swap in the latest checkpoint under `model_dir/{which}` if it is

@@ -27,6 +27,7 @@ zero-unexpected-retrace gate in `sim.fidelity`).
 
 from __future__ import annotations
 
+import time
 from typing import Callable
 
 import jax
@@ -36,6 +37,7 @@ from flax import struct
 
 from multihop_offload_tpu.graphs.instance import Instance, JobSet
 from multihop_offload_tpu.obs import jaxhooks
+from multihop_offload_tpu.obs import prof as obs_prof
 from multihop_offload_tpu.obs import trace as obs_trace
 from multihop_offload_tpu.obs.registry import registry
 from multihop_offload_tpu.obs.spans import span
@@ -152,7 +154,9 @@ class FleetSim:
                     collect_schedule,
                 )
 
-            self._fn = jax.jit(jax.vmap(one))
+            # registers with the prof layer on the first segment (AOT
+            # compile + cost analysis under the name every segment reuses)
+            self._fn = obs_prof.wrap("sim/scan", jax.jit(jax.vmap(one)))
 
     def init_states(self, fleet: int) -> SimState:
         s = init_state(self.spec, self.dtype)
@@ -185,8 +189,10 @@ class FleetSim:
         prev_del = int(jnp.sum(states.delivered))
         prev_drop = int(jnp.sum(states.dropped))
         with span("sim/scan", block=True, fleet=fleet):
+            t0 = time.perf_counter()  # nondet-ok(device-time accounting is a measurement)
             out = self._fn(insts, jobss, paramss, states, init_rates, keys)
             jax.block_until_ready(out.state.t)
+            self._fn.account(time.perf_counter() - t0)  # nondet-ok(same measurement)
         reg = registry()
         reg.counter(
             "mho_sim_slots_total", "simulated slots across the fleet"

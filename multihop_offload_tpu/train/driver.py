@@ -36,6 +36,7 @@ from multihop_offload_tpu.agent import (
 )
 from multihop_offload_tpu.config import Config
 from multihop_offload_tpu.obs import jaxhooks
+from multihop_offload_tpu.obs import prof as obs_prof
 from multihop_offload_tpu.obs.spans import span
 from multihop_offload_tpu.env import baseline_policy, local_policy
 from multihop_offload_tpu.models import load_reference_checkpoint, make_model
@@ -276,12 +277,17 @@ class _Harness:
             )(jobsets, keys)
             return bl, loc, gnn
 
-        self._gnn_train_step = jax.jit(gnn_train_step, donate_argnums=(1,))
-        self._eval_methods = jax.jit(eval_methods)
-        self._replay = jax.jit(
+        # single-device programs register with the prof layer (AOT compile
+        # + cost/memory analysis on first call); the shard_map dp variants
+        # below stay unwrapped — their dispatch is policed by parallel/
+        self._gnn_train_step = obs_prof.wrap(
+            "train/step", jax.jit(gnn_train_step, donate_argnums=(1,)))
+        self._eval_methods = obs_prof.wrap(
+            "train/eval", jax.jit(eval_methods))
+        self._replay = obs_prof.wrap("train/replay", jax.jit(
             partial(replay_apply, optimizer=self.optimizer,
                     batch=self.cfg.batch, max_norm=self.cfg.max_norm),
-        )
+        ))
         if self.mesh is not None:
             self._build_dp_steps(model, prob, use_dropout, critic_w, mse_w,
                                  compat_diag, apsp_fn, fp_fn, eval_methods)
@@ -653,6 +659,7 @@ class Trainer(_Harness):
                             (gnn_totals, loss_c, loss_m, bl, loc, gnn_test)
                         )
                     else:
+                        td0 = time.perf_counter()  # nondet-ok(device-time accounting is a measurement)
                         self.memory, gnn_totals, loss_c, loss_m = self._gnn_train_step(
                             self.variables, self.memory, inst, jobsets,
                             self.next_keys(cfg.num_instances),
@@ -664,6 +671,14 @@ class Trainer(_Harness):
                         )
                     next_build_s = pf.prefetch_next()
                     jax.block_until_ready(gnn_test)
+                    if self.n_dp <= 1:
+                        # combined train+eval window up to the sync; the
+                        # window goes to train/step, the eval program gets a
+                        # calls-only tick (device_s=0 skips its MFU gauge
+                        # rather than inventing a bogus split)
+                        self._gnn_train_step.account(
+                            time.perf_counter() - td0)  # nondet-ok(same measurement)
+                        self._eval_methods.account(0.0)
                 # runtime approximates METHOD compute only, net of the
                 # overlapped successor build — the reference's timer likewise
                 # excludes file prep (`AdHoc_test.py:126`).  With host and
@@ -701,12 +716,15 @@ class Trainer(_Harness):
                 if self.mem_count >= cfg.batch:
                     with span("train/replay", block=True):
                         self.key, k = jax.random.split(self.key)
+                        tr0 = time.perf_counter()  # nondet-ok(device-time accounting is a measurement)
                         params, self.opt_state, loss_dev = self._replay(
                             self.memory, self.variables["params"],
                             self.opt_state, key=k
                         )
                         self.variables = {"params": params}
                         loss = float(loss_dev)
+                        # the float() pull is the sync boundary
+                        self._replay.account(time.perf_counter() - tr0)  # nondet-ok(same measurement)
                     self.replay_losses.append(loss)
                 losses.append(loss)
 

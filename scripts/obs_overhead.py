@@ -1,11 +1,12 @@
 """Instrumentation overhead on the jitted train-step microbench.
 
 The obs acceptance gate: wrapping every step in a `span` (registry
-histogram observe + TraceAnnotation), emitting a JSONL step event, and
-running the jax.monitoring retrace listener must cost < 2% of step wall
-time.  Measures the SAME compiled forward_backward step (bench.py's
-workload, small preset) bare vs fully instrumented and commits
-`benchmarks/obs_overhead.json`.
+histogram observe + TraceAnnotation), emitting a JSONL step event,
+running the jax.monitoring retrace listener, AND the prof layer's
+per-call accounting (registered program counters + MFU/HBM gauge
+updates) must together cost < 2% of step wall time.  Measures the SAME
+compiled forward_backward step (bench.py's workload, small preset) bare
+vs fully instrumented and commits `benchmarks/obs_overhead.json`.
 
 Usage: python scripts/obs_overhead.py            # small CPU-friendly preset
        BENCH_NETWORKS=16 BENCH_INSTANCES=4 ...   # bench.py's env knobs apply
@@ -40,7 +41,13 @@ def main() -> int:
     from multihop_offload_tpu import obs
     from multihop_offload_tpu.agent import forward_backward
     from multihop_offload_tpu.obs import jaxhooks
+    from multihop_offload_tpu.obs import prof as obs_prof
     from multihop_offload_tpu.obs.spans import reset_phases, span
+
+    # MFU/HBM gauges must be live on CPU too — the gauge update is part of
+    # the measured accounting path, so give the registry a fake peak
+    os.environ.setdefault("MHO_PROF_PEAK_TFLOPS", "1.0")
+    os.environ.setdefault("MHO_PROF_PEAK_HBM_GBPS", "10.0")
 
     model, variables, binst, bjobs, pad, batch = build_bench_batch()
 
@@ -53,6 +60,15 @@ def main() -> int:
         return outs.grads, outs.loss_critic
 
     keys = jax.random.split(jax.random.PRNGKey(1), batch)
+    # register as the wired entry points do (AOT facts + correction), so
+    # the instrumented leg's account() exercises every counter and gauge
+    prof = obs_prof.prof_registry()
+    compiled = step.lower(variables, binst, bjobs, keys).compile()
+    prof.register(
+        "overhead/step", compiled,
+        correction=lambda f: obs_prof.scan_corrected_flops(
+            f, pad.n, pad.l, batch),
+    )
     out = step(variables, binst, bjobs, keys)
     jax.block_until_ready(out)
 
@@ -68,8 +84,10 @@ def main() -> int:
     def instrumented_leg(runlog):
         t0 = time.perf_counter()
         for r in range(reps):
+            ts = time.perf_counter()
             with span("train/step"):
                 o = step(variables, binst, bjobs, keys)
+            prof.account("overhead/step", time.perf_counter() - ts)
             runlog.step(gidx=r, wall_s=0.0)
         jax.block_until_ready(o)
         return time.perf_counter() - t0
@@ -98,8 +116,9 @@ def main() -> int:
     rec = {
         "description": "jitted forward_backward step loop, bare vs fully "
                        "instrumented (span + registry observe + JSONL step "
-                       "event + jax.monitoring listener active and steady); "
-                       "per-leg minima over 3 interleaved legs",
+                       "event + jax.monitoring listener active and steady "
+                       "+ prof per-call accounting with live MFU/HBM "
+                       "gauges); per-leg minima over 3 interleaved legs",
         "platform": jax.default_backend(),
         "batch": batch,
         "reps_per_leg": reps,

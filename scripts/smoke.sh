@@ -5,7 +5,8 @@
 #
 # Chains (each must pass; total budget a few minutes on a CPU host):
 #   1. bash scripts/lint.sh          — ruff (or the engine's pyflakes set)
-#      plus the repo's JAX-aware rules (JX001-JX006, MP001, SL001, OB001);
+#      plus the repo's JAX-aware rules (JX001-JX006, MP001, SL001,
+#      OB001, OB002);
 #   2. mho-lint --json               — the static-analysis engine alone,
 #      proving the JSON surface and the seeded-violation fixture dir
 #      (every rule must fire there — a rule that can't detect its target
@@ -31,7 +32,13 @@
 #      flight-recorder bundle dumps -> recovery resolves the alert ->
 #      drift detectors trip -> drift-triggered capture -> refit ->
 #      promote, with one request traced submit -> ... -> promotion across
-#      rotated log segments; writes benchmarks/health_smoke.json.
+#      rotated log segments; writes benchmarks/health_smoke.json;
+#   8. mho-prof --smoke             — the prof layer's drill: bench-step
+#      MFU/HBM gauge vs independent roofline within 1% (fake peaks),
+#      serving bucket registration with full cost/memory facts, injected
+#      SLO breach (latency + serve_mfu floor) -> profiler capture bundle
+#      next to the flight dump, per-call accounting under the 2% obs
+#      overhead budget; writes benchmarks/prof_smoke.json.
 #
 # This is the tier-1-ADJACENT gate (ROADMAP "quick checks") — it does not
 # replace the pytest tier-1 run.
@@ -40,10 +47,10 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
-echo "== [1/7] lint =="
+echo "== [1/8] lint =="
 bash scripts/lint.sh
 
-echo "== [2/7] mho-lint (engine: clean repo + every rule fires on seeds) =="
+echo "== [2/8] mho-lint (engine: clean repo + every rule fires on seeds) =="
 python -m multihop_offload_tpu.analysis.cli --json >/dev/null
 python - <<'EOF'
 import json, subprocess, sys
@@ -52,25 +59,28 @@ out = subprocess.run(
      "tests/fixtures/analysis_seeded"], capture_output=True, text=True)
 fired = {f["rule"] for f in json.loads(out.stdout)["findings"]}
 need = {"JX001", "JX002", "JX003", "JX004", "JX005", "JX006",
-        "MP001", "SL001", "OB001"}
+        "MP001", "SL001", "OB001", "OB002"}
 missing = sorted(need - fired)
 assert not missing, f"rules silent on their seeded violations: {missing}"
 print(f"mho-lint: all {len(need)} repo rules fire on the seeded fixtures")
 EOF
 
-echo "== [3/7] mho-sim --smoke =="
+echo "== [3/8] mho-sim --smoke =="
 python -m multihop_offload_tpu.cli.sim --smoke
 
-echo "== [4/7] mho-sim --smoke --layout sparse =="
+echo "== [4/8] mho-sim --smoke --layout sparse =="
 python -m multihop_offload_tpu.cli.sim --smoke --layout sparse
 
-echo "== [5/7] mho-loop --smoke =="
+echo "== [5/8] mho-loop --smoke =="
 python -m multihop_offload_tpu.cli.loop --smoke
 
-echo "== [6/7] mho-chaos --smoke =="
+echo "== [6/8] mho-chaos --smoke =="
 python -m multihop_offload_tpu.cli.chaos --smoke
 
-echo "== [7/7] mho-health --smoke =="
+echo "== [7/8] mho-health --smoke =="
 python -m multihop_offload_tpu.cli.health --smoke
+
+echo "== [8/8] mho-prof --smoke =="
+python -m multihop_offload_tpu.cli.prof --smoke
 
 echo "smoke: all green"

@@ -1,0 +1,12 @@
+"""Seeded violation: OB002 (direct XLA cost introspection outside obs/)."""
+
+
+def roll_your_own_roofline(jitted, args):
+    compiled = jitted.lower(*args).compile()
+    ca = compiled.cost_analysis()  # OB002: prof layer owns this surface
+    mem = compiled.memory_analysis()  # OB002: same
+    return ca, mem
+
+
+def waived_site(compiled):
+    return compiled.cost_analysis()  # prof-ok(test fixture waiver)

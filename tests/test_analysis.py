@@ -21,7 +21,7 @@ from multihop_offload_tpu.analysis.cli import main as lint_main
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SEEDED = os.path.join(REPO, "tests", "fixtures", "analysis_seeded")
 ALL_REPO_RULES = {"JX001", "JX002", "JX003", "JX004", "JX005", "JX006",
-                  "MP001", "SL001", "OB001"}
+                  "MP001", "SL001", "OB001", "OB002"}
 
 
 def run_on(tmp_path, files, select=None, baseline=None):
@@ -138,6 +138,26 @@ def test_ob001_tp_waived_and_pprint_guard(tmp_path):
 def test_ob001_exempts_cli(tmp_path):
     rep = run_on(tmp_path, {"cli/m.py": "print('console surface')\n"})
     assert "OB001" not in rules_hit(rep)
+
+
+def test_ob002_tp_waived_and_name_guard(tmp_path):
+    rep = run_on(tmp_path, {"train/m.py": """\
+        def facts(compiled, cost_analysis):
+            ca = compiled.cost_analysis()
+            mem = compiled.memory_analysis()  # prof-ok(test waiver)
+            stats = device.memory_stats()
+            other = cost_analysis()
+            return ca, mem, stats, other
+    """})
+    ob = [f for f in rep.findings if f.rule == "OB002"]
+    assert {f.line for f in ob} == {2, 4}  # bare-name call untouched
+    assert len([f for f in rep.waived if f.rule == "OB002"]) == 1
+
+
+def test_ob002_exempts_obs_dir(tmp_path):
+    rep = run_on(tmp_path, {
+        "obs/prof.py": "def f(c):\n    return c.cost_analysis()\n"})
+    assert "OB002" not in rules_hit(rep)
 
 
 def test_jx001_tp_waived_and_shadow_guard(tmp_path):

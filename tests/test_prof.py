@@ -1,0 +1,333 @@
+"""obs/prof + obs/memwatch: registration idempotence, FLOP-correction
+parity with bench.py, MFU/HBM gauge math under fake peaks, breach-capture
+fire-once semantics, degradation paths, and the report's performance
+section."""
+
+import math
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from multihop_offload_tpu.obs import prof as obs_prof
+from multihop_offload_tpu.obs.memwatch import MemWatch
+from multihop_offload_tpu.obs.prof import (
+    BreachCapture,
+    ProgramRegistry,
+    scan_corrected_flops,
+)
+from multihop_offload_tpu.obs.registry import MetricRegistry
+from multihop_offload_tpu.obs.slo import SLOEngine, default_serving_slos
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _series(reg: MetricRegistry, name: str) -> dict:
+    snap = reg.snapshot().get(name) or {}
+    return snap.get("series") or {}
+
+
+def _program_value(reg: MetricRegistry, name: str, program: str):
+    for labels, v in _series(reg, name).items():
+        if f'program="{program}"' in labels:
+            return v
+    return None
+
+
+# ---- registration -----------------------------------------------------------
+
+def test_register_idempotent_across_recompiles():
+    """Re-registering (hot-reload rebuild) refreshes facts and bumps the
+    compile count but preserves cumulative call/device counters."""
+    reg = MetricRegistry()
+    prof = ProgramRegistry(reg, peak_tflops_=1.0, peak_hbm_gbps_=1.0)
+    prof.register("p", flops=100.0, bytes_accessed=50.0, compile_s=1.0)
+    prof.account("p", 2.0, calls=4)
+    rec = prof.get("p")
+    assert rec.compiles == 1 and rec.calls == 4 and rec.device_s == 2.0
+
+    prof.register("p", flops=200.0, bytes_accessed=80.0, compile_s=0.5)
+    rec = prof.get("p")
+    assert rec.compiles == 2
+    assert rec.flops == 200.0 and rec.bytes_accessed == 80.0
+    assert rec.calls == 4 and rec.device_s == 2.0  # usage survives
+    assert rec.compile_s == 0.5
+    assert _program_value(reg, "mho_program_compile_seconds", "p") == 0.5
+
+
+def test_register_extracts_from_compiled_executable():
+    f = jax.jit(lambda x: (x @ x).sum())
+    compiled = f.lower(jnp.ones((16, 16))).compile()
+    prof = ProgramRegistry(MetricRegistry(), peak_tflops_=1.0,
+                           peak_hbm_gbps_=1.0)
+    rec = prof.register("mm", compiled, compile_s=0.1)
+    assert rec.flops and rec.flops > 0
+    assert rec.bytes_accessed and rec.bytes_accessed > 0
+    assert rec.to_json()["arithmetic_intensity"] is not None
+
+
+def test_wrap_registers_on_first_call_and_accounts():
+    reg = MetricRegistry()
+    prof = ProgramRegistry(reg, peak_tflops_=1.0, peak_hbm_gbps_=1.0)
+    calls = []
+    wrapped = obs_prof.wrap(
+        "w", jax.jit(lambda x: x + 1), prof=prof,
+        correction=lambda f: calls.append(f) or f)
+    out = wrapped(jnp.arange(4.0))
+    assert float(out[1]) == 2.0
+    rec = prof.get("w")
+    assert rec is not None and rec.compiles == 1
+    assert rec.compile_s is not None and rec.compile_s > 0
+    # second call reuses the compiled object — no re-register
+    wrapped(jnp.arange(4.0))
+    assert prof.get("w").compiles == 1
+    wrapped.account(0.5)
+    # the first accounted window deducts the pending compile time once
+    assert prof.get("w").device_s == pytest.approx(
+        max(0.5 - rec.compile_s, 0.0))
+
+
+def test_wrap_passes_keyword_arguments():
+    """The trainer calls its replay program with `key=`; the wrapper must
+    thread kwargs through both the AOT executable and the jit fallback."""
+    prof = ProgramRegistry(MetricRegistry(), peak_tflops_=1.0,
+                           peak_hbm_gbps_=1.0)
+    wrapped = obs_prof.wrap(
+        "kw", jax.jit(lambda x, *, scale: x * scale), prof=prof)
+    out = wrapped(jnp.arange(4.0), scale=jnp.float32(3.0))
+    assert float(out[2]) == 6.0
+    out = wrapped(jnp.arange(4.0), scale=jnp.float32(2.0))
+    assert float(out[3]) == 6.0
+    assert prof.get("kw") is not None
+
+
+def test_wrap_falls_back_to_jit_on_shape_change():
+    prof = ProgramRegistry(MetricRegistry(), peak_tflops_=1.0,
+                           peak_hbm_gbps_=1.0)
+    wrapped = obs_prof.wrap("shapes", jax.jit(lambda x: x * 2), prof=prof)
+    wrapped(jnp.arange(4.0))
+    out = wrapped(jnp.arange(8.0))  # AOT executable rejects; jit retraces
+    assert out.shape == (8,)
+    assert float(out[3]) == 6.0
+
+
+# ---- the FLOP correction ----------------------------------------------------
+
+def test_scan_corrected_flops_golden_parity_with_bench():
+    """The exact bench.py math, and bench aliases THIS function — forking
+    either copy fails here."""
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    import bench
+
+    assert bench._loop_corrected_flops is scan_corrected_flops
+
+    ca, n, l, b = 1e9, 24, 64, 8
+    iters = max(1, math.ceil(math.log2(n - 1)))
+    expect = ca + (iters - 1) * 2.0 * b * n**3 + 5 * 9 * 2.0 * b * l**2
+    assert scan_corrected_flops(ca, n, l, b) == pytest.approx(expect)
+    # pallas path charges nothing for the fp interior: all 10 passes added
+    expect_p = ca + (iters - 1) * 2.0 * b * n**3 + 5 * 10 * 2.0 * b * l**2
+    assert scan_corrected_flops(ca, n, l, b,
+                                fp_path="pallas") == pytest.approx(expect_p)
+
+
+def test_peak_tables_and_env_override(monkeypatch):
+    assert obs_prof.peak_tflops("TPU v4") == 275.0
+    assert obs_prof.peak_hbm_gbps("TPU v5e") == 819.0
+    assert obs_prof.peak_tflops("weird accelerator") is None
+    monkeypatch.setenv("MHO_PROF_PEAK_TFLOPS", "123.5")
+    assert obs_prof.peak_tflops("weird accelerator") == 123.5
+    monkeypatch.setenv("MHO_PROF_PEAK_TFLOPS", "not-a-number")
+    assert obs_prof.peak_tflops("TPU v2") == 46.0
+
+
+# ---- gauge math -------------------------------------------------------------
+
+def test_mfu_and_hbm_gauges_under_fake_peaks():
+    """Injected peaks: 1 TFLOP/s and 10 GB/s.  2e11 corrected flops and
+    4e9 bytes per call, 10 calls over 4 s -> MFU 0.5, HBM frac 1.0."""
+    reg = MetricRegistry()
+    prof = ProgramRegistry(reg, peak_tflops_=1.0, peak_hbm_gbps_=10.0)
+    prof.register("g", flops=2e11, bytes_accessed=4e9)
+    prof.account("g", 4.0, calls=10)
+    assert _program_value(reg, "mho_program_mfu", "g") == pytest.approx(0.5)
+    assert _program_value(
+        reg, "mho_program_hbm_frac", "g") == pytest.approx(1.0)
+    assert _program_value(
+        reg, "mho_program_flops_total", "g") == pytest.approx(2e12)
+    assert _program_value(
+        reg, "mho_program_bytes_total", "g") == pytest.approx(4e10)
+
+
+def test_no_gauges_without_peaks_or_time():
+    reg = MetricRegistry()
+    prof = ProgramRegistry(reg, peak_tflops_=None, peak_hbm_gbps_=None)
+    prof.register("q", flops=1e9, bytes_accessed=1e6)
+    prof.account("q", 1.0)
+    assert _program_value(reg, "mho_program_mfu", "q") is None
+    # zero device time: calls counted, no rate invented
+    prof2 = ProgramRegistry(MetricRegistry(), peak_tflops_=1.0,
+                            peak_hbm_gbps_=1.0)
+    prof2.register("z", flops=1e9, bytes_accessed=1e6)
+    prof2.account("z", 0.0)
+    assert prof2.get("z").calls == 1
+
+
+def test_snapshot_round_trips_records():
+    prof = ProgramRegistry(MetricRegistry(), peak_tflops_=1.0,
+                           peak_hbm_gbps_=1.0)
+    prof.register("s", flops=10.0, bytes_accessed=5.0, compile_s=0.2)
+    prof.account("s", 1.0, calls=2)
+    snap = prof.snapshot()
+    assert snap["s"]["flops"] == 10.0 and snap["s"]["calls"] == 2
+    assert snap["s"]["arithmetic_intensity"] == 2.0
+
+
+# ---- breach capture ---------------------------------------------------------
+
+def test_breach_capture_fires_exactly_once_per_breach(tmp_path):
+    """ok->firing grabs one capture; staying in breach grabs none; the
+    resolve->re-breach cycle grabs exactly one more."""
+    reg = MetricRegistry()
+    engine = SLOEngine(
+        default_serving_slos(latency_le=0.1), registry=reg,
+        short_s=2.0, long_s=8.0,
+    )
+    traced = []
+    cap = BreachCapture(
+        str(tmp_path), slos=("serve_p99",), clock=lambda: now[0],
+        tracer=lambda path, dur, fn: traced.append(path) or path,
+    )
+    engine.on_breach(cap.on_breach)
+    lat = reg.histogram("mho_serve_latency_seconds", "latency")
+    now = [0.0]
+
+    def drive(value, ticks):
+        for _ in range(ticks):
+            lat.observe(value)
+            now[0] += 1.0
+            engine.observe(now[0])
+
+    drive(0.5, 12)                       # breach: fires once
+    assert len(traced) == 1 and "serve_p99" in traced[0]
+    drive(0.5, 6)                        # still firing: no second capture
+    assert len(traced) == 1
+    drive(0.01, 30)                      # recover: alert resolves
+    assert engine.state()["serve_p99"]["state"] == "ok"
+    drive(0.5, 12)                       # re-breach: exactly one more
+    assert len(traced) == 2
+    assert cap.captures == traced
+
+
+def test_breach_capture_filters_and_cooldown(tmp_path):
+    traced = []
+    cap = BreachCapture(
+        str(tmp_path), slos=("serve_mfu",), clock=lambda: now[0],
+        min_interval_s=10.0,
+        tracer=lambda path, dur, fn: traced.append(path) or path,
+    )
+    now = [0.0]
+
+    class Spec:
+        name = "serve_p99"
+
+    assert cap.on_breach(Spec(), {}) == ""   # unwatched SLO: ignored
+    Spec.name = "serve_mfu"
+    assert cap.on_breach(Spec(), {})         # watched: captures
+    now[0] = 5.0
+    assert cap.on_breach(Spec(), {}) == ""   # inside cooldown
+    now[0] = 20.0
+    assert cap.on_breach(Spec(), {})
+    assert len(traced) == 2
+
+
+def test_gauge_min_slo_fires_on_low_mfu():
+    """The serve_mfu spec (gauge_min) breaches when any program's MFU
+    gauge sits under the floor, and ignores a registry with no gauge."""
+    reg = MetricRegistry()
+    engine = SLOEngine(
+        default_serving_slos(mfu_floor=0.5), registry=reg,
+        short_s=2.0, long_s=8.0,
+    )
+    for tick in range(12):               # no gauge at all: never fires
+        engine.observe(float(tick))
+    assert engine.state()["serve_mfu"]["state"] == "ok"
+    reg.gauge("mho_program_mfu", "").set(0.01, program="serve/bucket0/gnn")
+    for tick in range(12, 30):
+        engine.observe(float(tick))
+    assert engine.state()["serve_mfu"]["state"] == "firing"
+
+
+# ---- degradation ------------------------------------------------------------
+
+def test_capture_trace_never_raises_on_bad_dir():
+    path = obs_prof.capture_trace("/proc/definitely/not/writable")
+    assert path == ""
+
+
+def test_extract_cost_degrades_on_junk():
+    class Junk:
+        def cost_analysis(self):  # prof-ok(test double for the extractor)
+            raise RuntimeError("no backend")
+
+        def memory_analysis(self):  # prof-ok(same)
+            raise RuntimeError("no backend")
+
+    facts = obs_prof.extract_cost(Junk())
+    assert facts == {"flops": None, "bytes_accessed": None,
+                     "argument_bytes": None, "temp_bytes": None}
+
+
+def test_memwatch_degrades_and_tracks_watermarks():
+    reg = MetricRegistry()
+    stats = {"cpu:0": {"bytes_in_use": 10, "peak_bytes_in_use": 100}}
+    mw = MemWatch(reg, stats_fn=lambda: stats)
+    assert mw.snapshot("warm")
+    stats["cpu:0"]["peak_bytes_in_use"] = 50    # below the high water
+    mw.snapshot("later")
+    assert mw.watermarks()["cpu:0"] == 100
+
+    broken = MemWatch(reg, stats_fn=lambda: (_ for _ in ()).throw(
+        RuntimeError("wedged backend")))
+    assert broken.snapshot("x") == {}            # never raises
+
+
+# ---- report section ---------------------------------------------------------
+
+def test_report_performance_section_and_graceful_omission(tmp_path):
+    from multihop_offload_tpu.obs.events import RunLog, run_manifest
+    from multihop_offload_tpu.obs.report import load_run, render_report
+
+    path = str(tmp_path / "run.jsonl")
+    log = RunLog(path, manifest=run_manifest(role="prof"))
+    log.summary(
+        metrics={
+            "mho_program_mfu": {
+                "kind": "gauge", "help": "",
+                "series": {'{program="bench/step"}': 0.1234},
+            },
+        },
+        programs={
+            "bench/step": {"flops": 1e9, "flops_corrected": 2e9,
+                           "bytes_accessed": 1e8,
+                           "arithmetic_intensity": 20.0,
+                           "compile_s": 3.2, "compiles": 1,
+                           "calls": 10, "device_s": 1.5},
+        },
+    )
+    log.close()
+    run = load_run(path)
+    assert run["programs"]["bench/step"]["calls"] == 10
+    text = render_report(path)
+    assert "performance (per program)" in text
+    assert "bench/step" in text and "0.1234" in text
+
+    # pre-prof log: the section is omitted, nothing raises
+    old = str(tmp_path / "old.jsonl")
+    log2 = RunLog(old, manifest=run_manifest(role="train"))
+    log2.summary(phases={}, metrics={})
+    log2.close()
+    assert "performance (per program)" not in render_report(old)
