@@ -477,3 +477,41 @@ def check_jx006(mod: ModuleCtx) -> Iterator[Finding]:
                          "failure, or waive with '# swallow-ok(<why>)'"),
                 snippet=_snippet(mod, node),
             )
+
+
+# ---------------------------------------------------------------------------
+# JX007 — unplaced device_put in the serving path
+# ---------------------------------------------------------------------------
+
+
+@rule(
+    id="JX007", severity="error",
+    scope="serve/",
+    waiver="# placement-ok(",
+    doc=("`jax.device_put` without an explicit device/sharding in serve/ — "
+         "under the sharded executor the placement planner owns which chip "
+         "holds what; an unplaced put lands on jax's default device and "
+         "silently fights the plan"),
+    dirs=("serve",),
+)
+def check_jx007(mod: ModuleCtx) -> Iterator[Finding]:
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        canon = mod.canonical(node.func) if isinstance(
+            node.func, (ast.Name, ast.Attribute)) else None
+        if canon != "jax.device_put":
+            continue
+        explicit = len(node.args) >= 2 or any(
+            kw.arg in ("device", "sharding") for kw in node.keywords
+        )
+        if explicit:
+            continue
+        yield Finding(
+            rule="JX007", path=mod.path, line=node.lineno,
+            message=("jax.device_put() without a device/sharding argument — "
+                     "pass the planner's NamedSharding / target device so "
+                     "placement stays the planner's decision, or waive with "
+                     "'# placement-ok(<why>)'"),
+            snippet=_snippet(mod, node),
+        )

@@ -84,7 +84,7 @@ _REGISTRY: Dict[str, Rule] = {}
 # selection groups understood by the CLI's --select
 GROUPS = {
     # the repo-specific rules lint.sh runs on both branches
-    "repo": ("JX001", "JX002", "JX003", "JX004", "JX005", "JX006",
+    "repo": ("JX001", "JX002", "JX003", "JX004", "JX005", "JX006", "JX007",
              "MP001", "SL001", "OB001", "OB002"),
     # the ruff-approximation rules (E9/F401/F811) the fallback branch runs
     # over tests/ scripts/ bench.py as well as the package

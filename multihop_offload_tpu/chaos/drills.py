@@ -634,6 +634,100 @@ class ChaosSmoke:
             obs.finish_run(runlog)
         return self._finish(rec)
 
+    # ---- sharded fleet drills ----------------------------------------------
+
+    def run_device_loss(self) -> dict:
+        """Kill-one-device: a sharded service loses a chip between windows;
+        the placement planner must re-place every bucket onto the survivors
+        (forced — hysteresis cannot hold an invalid plan), conservation and
+        golden decisions must hold across the loss, and restoring the chip
+        must return it to the fleet.  Skips gracefully (recorded, ok) on a
+        1-device host — the CPU proof needs
+        XLA_FLAGS=--xla_force_host_platform_device_count=8."""
+        import jax
+
+        from multihop_offload_tpu.cli.serve import build_service
+        from multihop_offload_tpu.serve.workload import request_stream
+
+        n_dev = len(jax.devices())
+        if n_dev < 2:
+            rec = {
+                "name": "device_loss",
+                "injected": None, "recovered": True,
+                "skipped": f"needs >= 2 devices, host has {n_dev} "
+                           "(XLA_FLAGS=--xla_force_host_platform_"
+                           "device_count=8 for the CPU proof)",
+                "checks": {"skipped_gracefully": True},
+            }
+            return self._finish(rec)
+
+        cfg = dataclasses.replace(
+            self._drill_cfg("device_loss"),
+            serve_mesh=min(4, n_dev), serve_replan_ticks=2,
+        )
+        svc, _ = build_service(cfg, pool=self.pool, clock=self.clock)
+
+        def window(id_offset: int, count: int = 6) -> dict:
+            pending = list(request_stream(
+                self.pool, count, seed=cfg.seed + 1 + id_offset,
+                arrival_scale=cfg.arrival_scale, ul=cfg.ul_data,
+                dl=cfg.dl_data, t_max=float(cfg.T), id_offset=id_offset,
+            ))
+            pending.reverse()
+            out = {}
+            while pending or svc.queue_depth:
+                while pending:
+                    req = pending.pop()
+                    if not svc.submit(req):
+                        pending.append(req)
+                        break
+                for r in svc.tick():
+                    out[r.request_id] = r
+            return out
+
+        golden = window(id_offset=100_000)
+        multi_before = svc.executor.last_devices_used
+        victim = svc.executor.devices_for(0)[-1]
+        fleet_before = len(svc.planner.devices)
+        svc.lose_device(victim)
+        plan_after_loss = svc.planner.plan
+        # the SAME request ids re-served on the shrunken fleet: decisions
+        # are PRNG-keyed by request id, so bit-parity must survive the move
+        after = window(id_offset=100_000)
+        survived = {
+            rid: (np.array_equal(r.dst, golden[rid].dst)
+                  and np.array_equal(r.is_local, golden[rid].is_local))
+            or r.served_by == "baseline"
+            for rid, r in after.items()
+        }
+        svc.restore_device(victim)
+        # drive enough windows for the rate-driven re-plan cadence to see
+        # the restored chip
+        recovered_win = window(id_offset=100_200)
+        rec = {
+            "name": "device_loss",
+            "injected": f"device {getattr(victim, 'id', victim)} dropped "
+                        f"from a {fleet_before}-chip fleet mid-serving",
+            "recovered": True,
+            "checks": {
+                "multi_device_before_loss": multi_before > 1,
+                "plan_excludes_lost_device": not plan_after_loss.uses(victim),
+                "replaced_onto_survivors": all(
+                    len(devs) >= 1 for devs in plan_after_loss.assignments
+                ),
+                "decisions_never_wrong": bool(survived)
+                and all(survived.values()),
+                "conservation": (
+                    svc.stats.admitted == svc.stats.served
+                    and svc.queue_depth == 0
+                ),
+                "fleet_restored":
+                    len(svc.planner.devices) == fleet_before,
+                "served_after_restore": len(recovered_win) == 6,
+            },
+        }
+        return self._finish(rec)
+
     # ---- retrace discipline ------------------------------------------------
 
     def run_no_retrace_after_recovery(self) -> dict:
@@ -686,6 +780,7 @@ class ChaosSmoke:
         self.run_transient_io()
         self.run_cooldown_restart()
         self.run_candidate_gc()
+        self.run_device_loss()
         self.run_no_retrace_after_recovery()
         reg = obs_registry()
         record = {

@@ -20,6 +20,44 @@ import json
 from multihop_offload_tpu.config import Config, from_args
 
 
+def resolve_serve_devices(cfg: Config):
+    """The serving fleet from config: `serve_devices` (explicit id list,
+    e.g. "0,2,5") wins; else `serve_mesh` = N takes the first N local
+    devices, clamped (with a warning) when fewer exist.  Returns None for
+    the single-device executor — the default and the on-chip-record path
+    until a mesh is asked for."""
+    import warnings
+
+    import jax
+
+    spec = str(getattr(cfg, "serve_devices", "") or "").strip()
+    if spec:
+        by_id = {d.id: d for d in jax.devices()}
+        try:
+            ids = [int(s) for s in spec.split(",") if s.strip()]
+        except ValueError as e:
+            raise ValueError(f"serve_devices must be int ids: {spec!r}") from e
+        missing = [i for i in ids if i not in by_id]
+        if missing:
+            raise ValueError(
+                f"serve_devices {missing} not present (have "
+                f"{sorted(by_id)}); on CPU set "
+                "XLA_FLAGS=--xla_force_host_platform_device_count=N"
+            )
+        return [by_id[i] for i in ids]
+    mesh = int(getattr(cfg, "serve_mesh", 0) or 0)
+    if mesh <= 1:
+        return None
+    devs = jax.devices()
+    if mesh > len(devs):
+        warnings.warn(
+            f"serve_mesh={mesh} but only {len(devs)} devices present; "
+            f"clamping to {len(devs)}", RuntimeWarning, stacklevel=2,
+        )
+        mesh = len(devs)
+    return list(devs[:mesh])
+
+
 def build_service(cfg: Config, pool=None, clock=None):
     """Construct (service, pool) from config — shared by this CLI, the load
     generator, and the smoke tests so every entry point wires the same way.
@@ -55,6 +93,8 @@ def build_service(cfg: Config, pool=None, clock=None):
         dtype=cfg.jnp_dtype, precision=cfg.precision_policy,
         capture_sample=cfg.loop_capture_sample,
         trace=getattr(cfg, "obs_trace", True),
+        mesh_devices=resolve_serve_devices(cfg),
+        replan_every=max(1, int(getattr(cfg, "serve_replan_ticks", 16))),
         **({"clock": clock} if clock is not None else {}),
     )
     if cfg.health_watchdog_s > 0:

@@ -156,6 +156,15 @@ class Config:
     serve_sizes: str = "16,24"     # node sizes of the demo traffic pool
     #                                (cli.serve synthetic workload)
     serve_requests: int = 64       # demo request count (cli.serve)
+    serve_mesh: int = 0            # sharded serving: lay each bucket's batch
+    #                                axis over the first N local devices
+    #                                (0/1 = single-device executor); the
+    #                                placement planner assigns hot buckets
+    #                                more chips from observed arrival rates
+    serve_devices: str = ""        # explicit device-id list "0,2,5" for the
+    #                                serving fleet (overrides serve_mesh)
+    serve_replan_ticks: int = 16   # placement re-plan cadence (ticks); plans
+    #                                change BETWEEN ticks, never mid-program
     model_root: str = "model"      # parent dir of checkpoint directories
     tb_logdir: str = ""            # TensorBoard scalars ("" = disabled); the
     #                                working version of the reference's

@@ -22,6 +22,7 @@ reset the registry start from clean counts.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 
 from multihop_offload_tpu.obs.registry import registry as _registry
@@ -83,6 +84,23 @@ def mark_steady() -> None:
 def clear_steady() -> None:
     global _steady
     _steady = False
+
+
+@contextlib.contextmanager
+def expected_rebuild():
+    """Scope a DELIBERATE program build after steady state — a placement
+    change compiling a bucket's program for a new device set, a bucket
+    ladder rebuild — so its traces count as ordinary compiles, not
+    unexpected retraces.  Steady state is suspended for the scope and
+    restored on exit; anything that traces OUTSIDE such a scope after
+    `mark_steady()` is still a bug."""
+    global _steady
+    was = _steady
+    _steady = False
+    try:
+        yield
+    finally:
+        _steady = was
 
 
 def is_steady() -> bool:

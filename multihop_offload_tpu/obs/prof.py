@@ -254,13 +254,19 @@ class ProgramRegistry:
                  flops: Optional[float] = None,
                  bytes_accessed: Optional[float] = None,
                  argument_bytes: Optional[float] = None,
-                 temp_bytes: Optional[float] = None) -> ProgramRecord:
+                 temp_bytes: Optional[float] = None,
+                 labels: Optional[Dict[str, str]] = None) -> ProgramRecord:
         """Record one compiled program's cost/memory facts.  `compiled` is
         an AOT executable (cost extracted here, inside obs/); explicit
         keyword facts override extraction (tests, hand counts).
         `correction` maps raw cost-analysis flops to the corrected count
-        (see `scan_corrected_flops`); None means raw == corrected."""
+        (see `scan_corrected_flops`); None means raw == corrected.
+        `labels` (e.g. the sharded executor's `shard=`/`devices=`) land on
+        every exported metric series alongside `program=`, so gauges and
+        counters resolve per-shard; the `ProgramRecord` itself stays keyed
+        by name (a re-placement refreshes it like any bucket recompile)."""
         facts = extract_cost(compiled) if compiled is not None else {}
+        labels = labels or {}
         with self._lock:
             rec = self._programs.get(name)
             if rec is None:
@@ -288,27 +294,30 @@ class ProgramRegistry:
             reg.gauge(
                 "mho_program_compile_seconds",
                 "last AOT compile wall time per program",
-            ).set(round(rec.compile_s, 6), program=name)
+            ).set(round(rec.compile_s, 6), program=name, **labels)
         if rec.flops_corrected and rec.bytes_accessed:
             reg.gauge(
                 "mho_program_arithmetic_intensity",
                 "corrected flops / bytes accessed per program",
             ).set(round(rec.flops_corrected / rec.bytes_accessed, 4),
-                  program=name)
+                  program=name, **labels)
         if rec.temp_bytes is not None:
             reg.gauge(
                 "mho_program_temp_bytes",
                 "XLA temp allocation per program (peak scratch)",
-            ).set(rec.temp_bytes, program=name)
-        obs_events.emit("program", name=name, **rec.to_json())
+            ).set(rec.temp_bytes, program=name, **labels)
+        obs_events.emit("program", name=name, **labels, **rec.to_json())
         return rec
 
     # ---- run-time ------------------------------------------------------
 
-    def account(self, name: str, device_s: float, calls: int = 1) -> None:
+    def account(self, name: str, device_s: float, calls: int = 1,
+                labels: Optional[Dict[str, str]] = None) -> None:
         """Account `calls` invocations of `name` covering `device_s` of
         block-until-ready wall time (measured at the call site's natural
-        sync boundary).  Unregistered names accumulate calls/time only."""
+        sync boundary).  Unregistered names accumulate calls/time only.
+        `labels` mirror `register`'s: per-shard counter/gauge series."""
+        labels = labels or {}
         with self._lock:
             rec = self._programs.get(name)
             if rec is None:
@@ -321,19 +330,19 @@ class ProgramRegistry:
         reg = self._reg()
         reg.counter(
             "mho_program_calls_total", "program invocations"
-        ).inc(calls, program=name)
+        ).inc(calls, program=name, **labels)
         reg.counter(
             "mho_program_device_seconds_total",
             "accounted device wall seconds per program",
-        ).inc(max(float(device_s), 0.0), program=name)
+        ).inc(max(float(device_s), 0.0), program=name, **labels)
         if flops:
             reg.counter(
                 "mho_program_flops_total", "corrected flops executed"
-            ).inc(flops * calls, program=name)
+            ).inc(flops * calls, program=name, **labels)
         if bytes_:
             reg.counter(
                 "mho_program_bytes_total", "HBM bytes accessed"
-            ).inc(bytes_ * calls, program=name)
+            ).inc(bytes_ * calls, program=name, **labels)
         if total_s <= 0:
             return
         peak_tf, peak_bw = self._peaks()
@@ -344,13 +353,13 @@ class ProgramRegistry:
             reg.gauge(
                 "mho_program_mfu",
                 "cumulative corrected-flop rate over peak bf16 matmul",
-            ).set(round(mfu, 6), program=name)
+            ).set(round(mfu, 6), program=name, **labels)
         if bytes_ and peak_bw:
             frac = (bytes_ * total_calls / total_s) / (peak_bw * 1e9)
             reg.gauge(
                 "mho_program_hbm_frac",
                 "cumulative byte rate over peak HBM bandwidth",
-            ).set(round(frac, 6), program=name)
+            ).set(round(frac, 6), program=name, **labels)
 
     # ---- export --------------------------------------------------------
 
@@ -405,14 +414,23 @@ class ProfiledProgram:
 
     def __init__(self, name: str, jitted: Callable, *,
                  prof: Optional[ProgramRegistry] = None,
-                 correction: Optional[Callable[[float], float]] = None):
+                 correction: Optional[Callable[[float], float]] = None,
+                 labels: Optional[Dict[str, str]] = None):
         self.name = name
         self._jitted = jitted
         self._fn: Optional[Callable] = None
         self._prof = prof if prof is not None else prof_registry()
         self._correction = correction
+        self.labels = dict(labels or {})
         self._lock = threading.Lock()
         self._pending_compile_s = 0.0
+
+    @property
+    def built(self) -> bool:
+        """Whether the first call (AOT lower+compile, or jit fallback) has
+        happened — callers that must scope an expected compile (the sharded
+        executor building a new placement's program) check this."""
+        return self._fn is not None
 
     def _build(self, args, kwargs):
         t0 = time.perf_counter()  # nondet-ok(compile wall time is a measurement)
@@ -424,10 +442,11 @@ class ProfiledProgram:
         self._pending_compile_s = dt
         if compiled is not None:
             self._prof.register(self.name, compiled, compile_s=dt,
-                                correction=self._correction)
+                                correction=self._correction,
+                                labels=self.labels)
             return compiled
         self._prof.register(self.name, compile_s=dt,
-                            correction=self._correction)
+                            correction=self._correction, labels=self.labels)
         return self._jitted
 
     def __call__(self, *args, **kwargs):
@@ -459,14 +478,18 @@ class ProfiledProgram:
         with self._lock:
             pending, self._pending_compile_s = self._pending_compile_s, 0.0
         self._prof.account(self.name, max(float(device_s) - pending, 0.0),
-                           calls=calls)
+                           calls=calls, labels=self.labels)
 
 
 def wrap(name: str, jitted: Callable, *,
          prof: Optional[ProgramRegistry] = None,
-         correction: Optional[Callable[[float], float]] = None) -> ProfiledProgram:
-    """Wrap a `jax.jit` callable as a registered, AOT-compiled program."""
-    return ProfiledProgram(name, jitted, prof=prof, correction=correction)
+         correction: Optional[Callable[[float], float]] = None,
+         labels: Optional[Dict[str, str]] = None) -> ProfiledProgram:
+    """Wrap a `jax.jit` callable as a registered, AOT-compiled program.
+    `labels` (shard/device identity for the sharded executor) ride along
+    on every metric series the program exports."""
+    return ProfiledProgram(name, jitted, prof=prof, correction=correction,
+                           labels=labels)
 
 
 # ---- profiler capture ------------------------------------------------------

@@ -173,22 +173,28 @@ class Histogram(_Metric):
                 "min_s": s.min, "max_s": s.max,
             }
 
-    def _merged_counts(self):
+    def _merged_counts(self, labels: Optional[Dict[str, object]] = None):
         """Per-bucket counts summed over every label set (caller holds no
-        lock; this takes it).  Last slot is the +Inf tail."""
+        lock; this takes it) — or, with `labels`, over every series whose
+        label set CONTAINS them (the same subset match `Counter.total`
+        gives the SLO engine).  Last slot is the +Inf tail."""
+        want = set(_label_key(labels)) if labels else set()
         merged = [0] * (len(self.buckets) + 1)
         with self._lock:
-            for s in self._series.values():
+            for key, s in self._series.items():
+                if want and not want <= set(key):
+                    continue
                 for i, c in enumerate(s.bucket_counts):
                     merged[i] += c
         return merged
 
-    def le_total(self, le: float) -> Tuple[int, int]:
+    def le_total(self, le: float, **labels) -> Tuple[int, int]:
         """(observations <= le, total observations) across ALL label sets —
-        the good/total pair the SLO burn-rate engine samples.  `le` snaps
+        or the subset matching `labels` (per-shard SLO burn rates) — the
+        good/total pair the SLO burn-rate engine samples.  `le` snaps
         DOWN to the nearest bucket boundary (conservative: never counts an
         observation that might exceed the objective as good)."""
-        merged = self._merged_counts()
+        merged = self._merged_counts(labels)
         good = 0
         for b, c in zip(self.buckets, merged):
             if b > float(le):

@@ -75,7 +75,9 @@ class SLOSpec:
     le: float = 0.0                       # histogram_le: the latency bound
     bound: float = 0.0                    # gauge_max: ceiling / gauge_min: floor
     total_metric: str = ""                # ratio: denominator counter
-    labels: _LabelPairs = ()              # ratio: numerator label filter
+    labels: _LabelPairs = ()              # ratio: numerator label filter;
+    #                                       histogram_le: series filter
+    #                                       (per-shard burn rates)
     total_labels: _LabelPairs = ()        # ratio: denominator label filter
     description: str = ""
 
@@ -148,6 +150,32 @@ def default_serving_slos(
     return specs
 
 
+def sharded_serving_slos(
+    shards: Sequence[str],
+    latency_le: float = 0.25,
+    latency_objective: float = 0.99,
+) -> List[SLOSpec]:
+    """Per-shard p99 latency objectives over the SAME
+    `mho_serve_latency_seconds` histogram the fleet-wide `serve_p99` reads:
+    the sharded service stamps every response's latency observation with a
+    `shard=` label (the device that computed its slot), and each spec here
+    filters to one shard's series — so a single wedged chip burns ITS
+    budget and fires ITS alert while healthy shards stay green, the
+    per-shard mirror of the watchdog's per-shard verdicts.  `shards` are
+    the label values to watch, normally the fleet's device ids as strings
+    (`str(d.id)`)."""
+    return [
+        SLOSpec(
+            f"serve_p99_shard{s}", "histogram_le", "mho_serve_latency_seconds",
+            objective=latency_objective, le=latency_le,
+            labels=(("shard", str(s)),),
+            description=(f"p99 queue+serve latency <= {latency_le}s "
+                         f"on shard {s}"),
+        )
+        for s in shards
+    ]
+
+
 class _Series:
     """Per-spec cumulative (ts, good, total) samples plus alert state."""
 
@@ -212,7 +240,7 @@ class SLOEngine:
             m = self.registry._metrics.get(spec.metric)
             if not isinstance(m, Histogram):
                 return 0.0, 0.0
-            good, total = m.le_total(spec.le)
+            good, total = m.le_total(spec.le, **dict(spec.labels))
             return float(good), float(total)
         if spec.kind == "ratio":
             return (

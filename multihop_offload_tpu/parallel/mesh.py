@@ -11,6 +11,7 @@ The workload's natural scaling axes (SURVEY.md §2.8, §5.7):
 
 from __future__ import annotations
 
+import warnings
 from typing import Optional, Sequence
 
 import jax
@@ -23,13 +24,25 @@ def make_mesh(
     graph: int = 1,
     devices: Optional[Sequence] = None,
 ) -> Mesh:
+    """Lay `devices` (default: all) out as a (data, graph) grid.
+
+    A grid that does not fit the device count — more cells than devices, or
+    a `graph` axis larger than the fleet — degrades to a 1-D `data` axis
+    over every device with a warning instead of raising: callers sized for
+    one fleet shape (a serving config moved between hosts, a chip lost
+    mid-run) keep a working mesh, they just lose the graph partition."""
     devices = list(devices if devices is not None else jax.devices())
     if data is None:
         data = len(devices) // graph
-    if data * graph > len(devices):
-        raise ValueError(
-            f"mesh {data}x{graph} needs {data * graph} devices, have {len(devices)}"
+    if data * graph > len(devices) or data * graph == 0:
+        warnings.warn(
+            f"mesh {data}x{graph} needs {data * graph} devices, have "
+            f"{len(devices)}; falling back to a 1-D data axis over all "
+            f"{len(devices)}",
+            RuntimeWarning,
+            stacklevel=2,
         )
+        data, graph = len(devices), 1
     grid = np.asarray(devices[: data * graph]).reshape(data, graph)
     return Mesh(grid, axis_names=("data", "graph"))
 

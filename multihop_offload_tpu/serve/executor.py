@@ -58,8 +58,6 @@ class BucketExecutor:
         layout=None,
     ):
         from multihop_offload_tpu.layouts import resolve_layout
-        from multihop_offload_tpu.ops.fixed_point import resolve_fixed_point
-        from multihop_offload_tpu.ops.minplus import resolve_apsp
         from multihop_offload_tpu.precision import resolve_precision
 
         self.model = model
@@ -75,34 +73,13 @@ class BucketExecutor:
         # packer builds sparse-leaf instances and the steps close over the
         # policy, so the knob never appears as a traced value
         self.layout = resolve_layout(layout)
-        lay = self.layout
         self._steps = {}
+        self._closures = {}
         for b, pad in enumerate(buckets.pads):
-            apsp_fn, _ = resolve_apsp(apsp_impl, pad.n)
-            apsp_fn = self.precision.wrap_apsp(apsp_fn)
-            fp_fn, _ = resolve_fixed_point(fp_impl, pad.l)
-
-            def gnn_step(variables, binst, bjobs, keys,
-                         _apsp=apsp_fn, _fp=fp_fn):
-                def one(inst, jb, k):
-                    outcome, _ = forward_env(
-                        model, variables, inst, jb, k, prob=prob,
-                        apsp_fn=_apsp, fp_fn=_fp, layout=lay,
-                    )
-                    d = outcome.decision
-                    return d.dst, d.is_local, d.delay_est, outcome.job_total
-
-                return jax.vmap(one)(binst, bjobs, keys)
-
-            def baseline_step(binst, bjobs, keys, _apsp=apsp_fn, _fp=fp_fn):
-                def one(inst, jb, k):
-                    o = baseline_policy(inst, jb, k, apsp_fn=_apsp, fp_fn=_fp,
-                                        layout=lay)
-                    d = o.decision
-                    return d.dst, d.is_local, d.delay_est, o.job_total
-
-                return jax.vmap(one)(binst, bjobs, keys)
-
+            gnn_step, baseline_step = self._bucket_closures(
+                pad, apsp_impl, fp_impl, prob
+            )
+            self._closures[b] = (gnn_step, baseline_step)
             # each bucket program registers with the prof layer on its
             # first dispatch (AOT compile + cost/memory analysis); the
             # compiled executable then serves every later tick
@@ -116,6 +93,44 @@ class BucketExecutor:
                     jax.jit(baseline_step),  # retrace-ok(same: the loop IS the build)
                 ),
             )
+
+    def _bucket_closures(self, pad, apsp_impl: str, fp_impl: str, prob: bool):
+        """The raw (gnn_step, baseline_step) python closures for one bucket
+        pad — the single source both the single-device jit programs here AND
+        the mesh-sharded executor's NamedSharding programs compile from, so
+        the two paths can never drift in decision math (the bit-parity
+        property `tests/test_serve_sharded.py` pins)."""
+        from multihop_offload_tpu.ops.fixed_point import resolve_fixed_point
+        from multihop_offload_tpu.ops.minplus import resolve_apsp
+
+        apsp_fn, _ = resolve_apsp(apsp_impl, pad.n)
+        apsp_fn = self.precision.wrap_apsp(apsp_fn)
+        fp_fn, _ = resolve_fixed_point(fp_impl, pad.l)
+        lay = self.layout
+        model = self.model
+
+        def gnn_step(variables, binst, bjobs, keys,
+                     _apsp=apsp_fn, _fp=fp_fn):
+            def one(inst, jb, k):
+                outcome, _ = forward_env(
+                    model, variables, inst, jb, k, prob=prob,
+                    apsp_fn=_apsp, fp_fn=_fp, layout=lay,
+                )
+                d = outcome.decision
+                return d.dst, d.is_local, d.delay_est, outcome.job_total
+
+            return jax.vmap(one)(binst, bjobs, keys)
+
+        def baseline_step(binst, bjobs, keys, _apsp=apsp_fn, _fp=fp_fn):
+            def one(inst, jb, k):
+                o = baseline_policy(inst, jb, k, apsp_fn=_apsp, fp_fn=_fp,
+                                    layout=lay)
+                d = o.decision
+                return d.dst, d.is_local, d.delay_est, o.job_total
+
+            return jax.vmap(one)(binst, bjobs, keys)
+
+        return gnn_step, baseline_step
 
     def run(self, bucket: int, binst, bjobs, keys, degraded: bool = False,
             request_ids=None):

@@ -16,7 +16,7 @@ registry is the cross-subsystem export.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from multihop_offload_tpu.obs.registry import LATENCY_BUCKETS
 from multihop_offload_tpu.obs.registry import registry as _registry
@@ -29,6 +29,7 @@ class _BucketStats:
     dispatches: int = 0
     degraded_dispatches: int = 0
     served: int = 0
+    offered: int = 0               # admission attempts routed to this bucket
     occupancy_sum: float = 0.0     # real requests / slots, summed per dispatch
     waste_jobs_sum: float = 0.0    # job-slot padding waste, summed per dispatch
     waste_nodes_sum: float = 0.0
@@ -48,14 +49,21 @@ class ServingStats:
     ticks: int = 0
     latencies_s: List[float] = dataclasses.field(default_factory=list)
     buckets: Dict[int, _BucketStats] = dataclasses.field(default_factory=dict)
+    # per-shard (device id) served counts, sharded executor only
+    shard_served: Dict[str, int] = dataclasses.field(default_factory=dict)
 
     def bucket(self, b: int) -> _BucketStats:
         return self.buckets.setdefault(b, _BucketStats())
 
-    def record_submit(self, outcome: str) -> None:
+    def record_submit(self, outcome: str, bucket: Optional[int] = None) -> None:
         """One admission decision: 'admitted', 'backpressure' (bounded-queue
-        refusal) or 'too_large' (no bucket fits)."""
+        refusal) or 'too_large' (no bucket fits).  `bucket` (known for both
+        admitted and backpressured requests) feeds the per-bucket OFFERED
+        rate — the demand signal the placement planner and the loadgen's
+        offered-vs-served block are built from."""
         self.submitted += 1
+        if bucket is not None:
+            self.bucket(bucket).offered += 1
         if outcome == "admitted":
             self.admitted += 1
         elif outcome == "backpressure":
@@ -87,9 +95,13 @@ class ServingStats:
         ).inc(waste["jobs"], bucket=str(b))
 
     def record_batch(self, n_real: int, decisions: int, degraded: bool,
-                     latencies_s: List[float]) -> None:
+                     latencies_s: List[float],
+                     shards: Optional[List[str]] = None) -> None:
         """One served batch's responses: counts plus per-request queue+serve
-        latencies (mirrored into the `mho_serve_latency_seconds` histogram)."""
+        latencies (mirrored into the `mho_serve_latency_seconds` histogram).
+        `shards[i]` (sharded executor: the device id that computed slot i)
+        labels each latency observation so the per-shard SLO burn rates
+        (`obs.slo.sharded_serving_slos`) see only their own device's tail."""
         self.served += n_real
         self.degraded += n_real if degraded else 0
         self.decisions += decisions
@@ -110,8 +122,13 @@ class ServingStats:
             "mho_serve_latency_seconds", "request queue+serve latency",
             buckets=LATENCY_BUCKETS,
         )
-        for x in latencies_s:
-            lat.observe(x)
+        if shards:
+            for x, s in zip(latencies_s, shards):
+                lat.observe(x, shard=s)
+                self.shard_served[s] = self.shard_served.get(s, 0) + 1
+        else:
+            for x in latencies_s:
+                lat.observe(x)
 
     @property
     def dispatches(self) -> int:
@@ -147,6 +164,26 @@ class ServingStats:
             "latency": lat,
             "per_bucket": per_bucket,
         }
+        # offered (admission attempts) vs served, per bucket — the demand/
+        # capacity view the placement planner acts on.  A sub-block, so the
+        # serving.json schema stays backward compatible.
+        buckets_block = {}
+        for b, s in sorted(self.buckets.items()):
+            entry = {"offered": s.offered, "served": s.served}
+            if wall_s > 0:
+                entry["offered_per_sec"] = round(s.offered / wall_s, 2)
+                entry["served_per_sec"] = round(s.served / wall_s, 2)
+            buckets_block[str(b)] = entry
+        if buckets_block:
+            out["buckets"] = buckets_block
+        if self.shard_served:
+            shards_block = {}
+            for dev, n in sorted(self.shard_served.items()):
+                entry = {"served": n}
+                if wall_s > 0:
+                    entry["served_per_sec"] = round(n / wall_s, 2)
+                shards_block[dev] = entry
+            out["shards"] = shards_block
         if wall_s > 0:
             out["wall_s"] = round(wall_s, 3)
             out["requests_per_sec"] = round(self.served / wall_s, 2)

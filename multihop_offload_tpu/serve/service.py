@@ -66,6 +66,9 @@ class OffloadService:
         clock: Callable[[], float] = time.monotonic,
         capture_sample: float = 0.0,
         trace: bool = True,
+        mesh_devices: Optional[List] = None,
+        replan_every: int = 16,
+        placement_hysteresis: float = 0.2,
     ):
         from multihop_offload_tpu.layouts import resolve_layout
         from multihop_offload_tpu.precision import resolve_precision
@@ -80,11 +83,38 @@ class OffloadService:
         # (`models.chebconv.make_model(cfg, layout=...)`).
         self.precision = resolve_precision(precision, dtype)
         self.layout = resolve_layout(layout)
-        self.executor = BucketExecutor(
-            model, variables, buckets,
-            apsp_impl=apsp_impl, fp_impl=fp_impl, prob=prob,
-            precision=self.precision, layout=self.layout,
-        )
+        # `mesh_devices` selects the sharded tick: each bucket's batch axis
+        # is laid over a subset of these devices, chosen by a greedy
+        # placement planner from observed per-bucket arrival rates and
+        # re-planned every `replan_every` ticks — BETWEEN ticks, never
+        # mid-program (serve.sharded / serve.placement).
+        self.planner = None
+        if mesh_devices:
+            from multihop_offload_tpu.serve.placement import PlacementPlanner
+            from multihop_offload_tpu.serve.sharded import ShardedBucketExecutor
+
+            self.executor = ShardedBucketExecutor(
+                model, variables, buckets, devices=mesh_devices, slots=slots,
+                apsp_impl=apsp_impl, fp_impl=fp_impl, prob=prob,
+                precision=self.precision, layout=self.layout,
+            )
+            self.planner = PlacementPlanner(
+                len(buckets.pads), mesh_devices, slots,
+                hysteresis=placement_hysteresis,
+            )
+            self.executor.set_placement(self.planner.plan)
+        else:
+            self.executor = BucketExecutor(
+                model, variables, buckets,
+                apsp_impl=apsp_impl, fp_impl=fp_impl, prob=prob,
+                precision=self.precision, layout=self.layout,
+            )
+        self.replan_every = max(1, int(replan_every))
+        # per-bucket admitted arrivals in the current planning window (the
+        # planner's rate signal) and per-device stuck-until deadlines (a
+        # stuck device degrades only the buckets placed on it)
+        self._arrivals: List[int] = [0] * len(buckets.pads)
+        self._stuck_devices: dict = {}
         self.buckets = buckets
         self.slots = slots
         self.queue_cap = queue_cap
@@ -132,10 +162,11 @@ class OffloadService:
             self.stats.record_submit("too_large")
             return False
         if self.queue_depth >= self.queue_cap:
-            self.stats.record_submit("backpressure")
+            self.stats.record_submit("backpressure", bucket=b)
             return False
         self._queues[b].append((req, self.clock() if now is None else now))
-        self.stats.record_submit("admitted")
+        self.stats.record_submit("admitted", bucket=b)
+        self._arrivals[b] += 1
         obs_registry().gauge(
             "mho_serve_queue_depth", "pending admitted requests"
         ).set(self.queue_depth)
@@ -160,6 +191,64 @@ class OffloadService:
         timed on the service clock; a stuck verdict degrades that bucket to
         the baseline program until the watchdog's recovery window passes."""
         self.watchdog = watchdog
+
+    # ---- sharded placement / per-device health -----------------------------
+
+    def _between_ticks(self, now: Optional[float]) -> None:
+        """Sharded-mode housekeeping, run BEFORE any dispatch of the tick:
+        expire per-device stuck windows, and every `replan_every` ticks feed
+        the arrival window to the placement planner and adopt its plan.
+        Placement therefore only ever changes between programs — the
+        zero-retrace and hot-reload invariants never see a mid-tick move."""
+        t_now = self.clock() if now is None else now
+        for d, until in list(self._stuck_devices.items()):
+            if t_now >= until:
+                del self._stuck_devices[d]
+                obs_registry().counter(
+                    "mho_watchdog_device_recoveries_total",
+                    "devices restored after a stuck window",
+                ).inc(device=str(getattr(d, "id", d)))
+                obs_events.emit("watchdog_device_recovered",
+                                device=str(getattr(d, "id", d)))
+        if self.stats.ticks % self.replan_every == 0:
+            self.planner.observe(self._arrivals)
+            self._arrivals = [0] * len(self._queues)
+            plan = self.planner.replan()
+            if plan.assignments != self.executor.plan.assignments:
+                self.executor.set_placement(plan)
+
+    def _devices_stuck(self, devices, t_now: float) -> bool:
+        return any(self._stuck_devices.get(d, -float("inf")) > t_now
+                   for d in devices)
+
+    def lose_device(self, device) -> None:
+        """Drop a device from the serving fleet (chaos drill / operator
+        action).  Forces an immediate re-plan onto the survivors; the next
+        tick's programs simply exclude the lost chip."""
+        if self.planner is None:
+            raise RuntimeError("lose_device requires a sharded service "
+                               "(mesh_devices)")
+        self.planner.remove_device(device)
+        self.executor.set_placement(self.planner.plan)
+        self._stuck_devices.pop(device, None)
+        obs_registry().counter(
+            "mho_serve_devices_lost_total", "devices dropped from the fleet"
+        ).inc(device=str(getattr(device, "id", device)))
+        obs_events.emit("device_lost",
+                        device=str(getattr(device, "id", device)),
+                        fleet=len(self.planner.devices))
+
+    def restore_device(self, device) -> None:
+        """Return a previously lost device to the fleet; the planner may
+        re-adopt it at the next forced or rate-driven re-plan."""
+        if self.planner is None:
+            raise RuntimeError("restore_device requires a sharded service "
+                               "(mesh_devices)")
+        self.planner.add_device(device)
+        self.executor.set_placement(self.planner.plan)
+        obs_events.emit("device_restored",
+                        device=str(getattr(device, "id", device)),
+                        fleet=len(self.planner.devices))
 
     def _sparse_fit(self, req: OffloadRequest, b: int) -> Optional[int]:
         """Escalate to the first bucket whose STATIC nnz pads also hold this
@@ -187,6 +276,8 @@ class OffloadService:
     def tick(self, now: Optional[float] = None) -> List[OffloadResponse]:
         """Serve one batch per non-empty bucket; returns demuxed responses."""
         self.stats.ticks += 1
+        if self.planner is not None:
+            self._between_ticks(now)
         responses: List[OffloadResponse] = []
         degraded_batches = 0
         with span("serve/tick"):
@@ -204,8 +295,13 @@ class OffloadService:
                         "buckets restored to the GNN program",
                     ).inc(bucket=b)
                     obs_events.emit("watchdog_recovered", bucket=b)
+                placed = (self.executor.devices_for(b)
+                          if self.planner is not None else ())
+                # a stuck DEVICE degrades only the buckets placed on it —
+                # per-shard, never fleet-wide
+                dev_stuck = bool(placed) and self._devices_stuck(placed, t_now)
                 degraded = ((t_now - q[0][1]) > self.deadline_s
-                            or held is not None)
+                            or held is not None or dev_stuck)
                 degraded_batches += int(degraded)
                 taken = [q.popleft() for _ in range(min(self.slots, len(q)))]
                 reqs = [r for r, _ in taken]
@@ -233,14 +329,31 @@ class OffloadService:
                 if self.watchdog is not None:
                     # clamp at zero: backward clock skew must not trip it
                     verdict = self.watchdog.observe(
-                        b, max(t_done - t_now, 0.0), now=t_done
+                        b, max(t_done - t_now, 0.0), now=t_done,
+                        devices=placed or None,
                     )
                     if verdict == "stuck" and self.watchdog.recovery_s > 0:
-                        self._degraded_until[b] = (
-                            t_done + self.watchdog.recovery_s
-                        )
+                        if placed:
+                            # per-shard: pin the stuck window to the DEVICES
+                            # this bucket ran on; co-placed buckets degrade,
+                            # buckets on other chips keep the GNN
+                            until = t_done + self.watchdog.recovery_s
+                            for d in placed:
+                                self._stuck_devices[d] = until
+                        else:
+                            self._degraded_until[b] = (
+                                t_done + self.watchdog.recovery_s
+                            )
+                shards = None
+                if placed:
+                    shards = [
+                        str(getattr(d, "id", d))
+                        for d in (self.executor.shard_of_slot(b, i)
+                                  for i in range(len(taken)))
+                    ]
                 batch_responses = demux_responses(
-                    taken, out, "baseline" if degraded else "gnn", b, t_done
+                    taken, out, "baseline" if degraded else "gnn", b, t_done,
+                    shards=shards,
                 )
                 if tracing:
                     obs_trace.hop(
@@ -258,6 +371,7 @@ class OffloadService:
                 self.stats.record_batch(
                     len(reqs), sum(r.num_jobs for r in reqs), degraded,
                     [max(t_done - t_enq, 0.0) for _, t_enq in taken],
+                    shards=shards,
                 )
         depth = self.queue_depth
         obs_registry().gauge(
@@ -346,10 +460,13 @@ def demux_responses(
     served_by: str,
     bucket: int,
     t_done: float,
+    shards: Optional[List[str]] = None,
 ) -> List[OffloadResponse]:
     """The response demultiplexer: slice each real slot's padded decision
     arrays down to the request's true job count.  Pad slots (batch filler)
-    and pad job entries are dropped here and never reach a client."""
+    and pad job entries are dropped here and never reach a client.  Under
+    the sharded executor `shards[i]` names the device that computed slot i's
+    decision, stamped on the response for per-shard attribution."""
     dst, is_local, delay_est, job_total = out
     responses = []
     for i, (req, t_enq) in enumerate(taken):
@@ -363,5 +480,6 @@ def demux_responses(
             served_by=served_by,
             bucket=bucket,
             latency_s=max(t_done - t_enq, 0.0),
+            shard=shards[i] if shards else "",
         ))
     return responses

@@ -41,31 +41,48 @@ class TickWatchdog:
         self.stuck = 0
 
     def observe(self, bucket: int, duration_s: float,
-                now: Optional[float] = None) -> str:
-        """Classify one dispatch: "ok" | "slow" | "stuck"."""
+                now: Optional[float] = None,
+                devices: Optional[tuple] = None) -> str:
+        """Classify one dispatch: "ok" | "slow" | "stuck".
+
+        `devices` (the sharded executor's placement for this bucket) makes
+        the verdict PER-SHARD: counters and the `watchdog` event carry a
+        `device=` label per placed chip, and the sharded service scopes the
+        resulting degradation to those devices — a stuck chip costs only
+        the shards placed on it, never the fleet."""
+        dev_ids = tuple(getattr(d, "id", d) for d in (devices or ()))
         if duration_s <= self.threshold_s:
             return "ok"
         verdict = ("stuck" if duration_s > self.threshold_s * self.stuck_factor
                    else "slow")
-        if verdict == "slow":
-            self.slow += 1
+        counter = (
             obs_registry().counter(
                 "mho_watchdog_slow_total", "bucket dispatches over threshold"
-            ).inc(bucket=bucket)
-        else:
-            self.stuck += 1
+            ) if verdict == "slow" else
             obs_registry().counter(
                 "mho_watchdog_stuck_total",
                 "bucket dispatches classified stuck (degraded to baseline)",
-            ).inc(bucket=bucket)
+            )
+        )
+        if verdict == "slow":
+            self.slow += 1
+        else:
+            self.stuck += 1
+        if dev_ids:
+            for d in dev_ids:
+                counter.inc(bucket=bucket, device=str(d))
+        else:
+            counter.inc(bucket=bucket)
         obs_events.emit("watchdog", verdict=verdict, bucket=bucket,
                         duration_s=round(float(duration_s), 6),
-                        threshold_s=self.threshold_s)
+                        threshold_s=self.threshold_s,
+                        **({"devices": list(dev_ids)} if dev_ids else {}))
         if verdict == "stuck" and self.recorder is not None and self.flight_dir:
             self.recorder.dump(
                 self.flight_dir, reason=f"watchdog-stuck-bucket{bucket}",
                 alerts=[{"kind": "watchdog", "bucket": bucket,
                          "duration_s": float(duration_s),
-                         "threshold_s": self.threshold_s}],
+                         "threshold_s": self.threshold_s,
+                         "devices": list(dev_ids)}],
             )
         return verdict

@@ -5,7 +5,7 @@
 #
 # Chains (each must pass; total budget a few minutes on a CPU host):
 #   1. bash scripts/lint.sh          — ruff (or the engine's pyflakes set)
-#      plus the repo's JAX-aware rules (JX001-JX006, MP001, SL001,
+#      plus the repo's JAX-aware rules (JX001-JX007, MP001, SL001,
 #      OB001, OB002);
 #   2. mho-lint --json               — the static-analysis engine alone,
 #      proving the JSON surface and the seeded-violation fixture dir
@@ -38,7 +38,11 @@
 #      serving bucket registration with full cost/memory facts, injected
 #      SLO breach (latency + serve_mfu floor) -> profiler capture bundle
 #      next to the flight dump, per-call accounting under the 2% obs
-#      overhead budget; writes benchmarks/prof_smoke.json.
+#      overhead budget; writes benchmarks/prof_smoke.json;
+#   9. sharded serve smoke        — an OffloadService on a 4-chip mesh of
+#      virtual host devices (XLA_FLAGS=--xla_force_host_platform_device_
+#      count=8): serves a window and asserts >1 device actually computed
+#      the batch, read off the output arrays' sharding.
 #
 # This is the tier-1-ADJACENT gate (ROADMAP "quick checks") — it does not
 # replace the pytest tier-1 run.
@@ -47,10 +51,10 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
-echo "== [1/8] lint =="
+echo "== [1/9] lint =="
 bash scripts/lint.sh
 
-echo "== [2/8] mho-lint (engine: clean repo + every rule fires on seeds) =="
+echo "== [2/9] mho-lint (engine: clean repo + every rule fires on seeds) =="
 python -m multihop_offload_tpu.analysis.cli --json >/dev/null
 python - <<'EOF'
 import json, subprocess, sys
@@ -59,28 +63,47 @@ out = subprocess.run(
      "tests/fixtures/analysis_seeded"], capture_output=True, text=True)
 fired = {f["rule"] for f in json.loads(out.stdout)["findings"]}
 need = {"JX001", "JX002", "JX003", "JX004", "JX005", "JX006",
-        "MP001", "SL001", "OB001", "OB002"}
+        "JX007", "MP001", "SL001", "OB001", "OB002"}
 missing = sorted(need - fired)
 assert not missing, f"rules silent on their seeded violations: {missing}"
 print(f"mho-lint: all {len(need)} repo rules fire on the seeded fixtures")
 EOF
 
-echo "== [3/8] mho-sim --smoke =="
+echo "== [3/9] mho-sim --smoke =="
 python -m multihop_offload_tpu.cli.sim --smoke
 
-echo "== [4/8] mho-sim --smoke --layout sparse =="
+echo "== [4/9] mho-sim --smoke --layout sparse =="
 python -m multihop_offload_tpu.cli.sim --smoke --layout sparse
 
-echo "== [5/8] mho-loop --smoke =="
+echo "== [5/9] mho-loop --smoke =="
 python -m multihop_offload_tpu.cli.loop --smoke
 
-echo "== [6/8] mho-chaos --smoke =="
+echo "== [6/9] mho-chaos --smoke =="
 python -m multihop_offload_tpu.cli.chaos --smoke
 
-echo "== [7/8] mho-health --smoke =="
+echo "== [7/9] mho-health --smoke =="
 python -m multihop_offload_tpu.cli.health --smoke
 
-echo "== [8/8] mho-prof --smoke =="
+echo "== [8/9] mho-prof --smoke =="
 python -m multihop_offload_tpu.cli.prof --smoke
+
+echo "== [9/9] sharded serve smoke (8 virtual devices) =="
+XLA_FLAGS="--xla_force_host_platform_device_count=8" python - <<'PYEOF'
+from multihop_offload_tpu.cli.serve import build_service
+from multihop_offload_tpu.config import Config
+from multihop_offload_tpu.serve.workload import request_stream
+
+cfg = Config(serve_sizes="10", serve_buckets=1, serve_slots=4, serve_mesh=4,
+             serve_deadline_s=60.0)
+service, pool = build_service(cfg)
+for req in request_stream(pool, 12, seed=3):
+    assert service.submit(req)
+responses = service.drain()
+assert len(responses) == 12, f"served {len(responses)}/12"
+used = service.executor.last_devices_used
+assert used > 1, f"sharded dispatch used {used} device(s); expected > 1"
+print(f"sharded serve: {len(responses)} requests over {used} devices, "
+      f"placement {service.planner.plan.describe()}")
+PYEOF
 
 echo "smoke: all green"

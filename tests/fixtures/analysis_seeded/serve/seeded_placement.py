@@ -1,0 +1,11 @@
+"""Seeded violation: JX007 (unplaced device_put in the serving path)."""
+
+import jax
+
+
+def stage_weights(variables):
+    # JX007: no device/sharding — lands on jax's default device and fights
+    # the placement planner's assignment
+    staged = jax.device_put(variables)
+    ok = jax.device_put(variables, jax.devices()[0])  # explicit: clean
+    return staged, ok

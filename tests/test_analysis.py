@@ -21,7 +21,7 @@ from multihop_offload_tpu.analysis.cli import main as lint_main
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SEEDED = os.path.join(REPO, "tests", "fixtures", "analysis_seeded")
 ALL_REPO_RULES = {"JX001", "JX002", "JX003", "JX004", "JX005", "JX006",
-                  "MP001", "SL001", "OB001", "OB002"}
+                  "JX007", "MP001", "SL001", "OB001", "OB002"}
 
 
 def run_on(tmp_path, files, select=None, baseline=None):
@@ -391,6 +391,55 @@ def test_jx006_scoped_to_recovery_dirs(tmp_path):
     assert "JX006" not in rules_hit(rep)
     rep = run_on(tmp_path, {"obs/m.py": src})
     assert "JX006" in rules_hit(rep)
+
+
+def test_jx007_unplaced_device_put_tp_waived_and_explicit(tmp_path):
+    rep = run_on(tmp_path, {"serve/m.py": """\
+        import jax
+
+        def tp(x):
+            return jax.device_put(x)
+
+        def waived(x):
+            return jax.device_put(x)  # placement-ok(single-host tool path)
+
+        def explicit(x, dev, shard):
+            a = jax.device_put(x, dev)
+            b = jax.device_put(x, device=dev)
+            c = jax.device_put(x, sharding=shard)
+            return a, b, c
+    """})
+    jx = [f for f in rep.findings if f.rule == "JX007"]
+    assert len(jx) == 1 and jx[0].line == 4
+    assert len([f for f in rep.waived if f.rule == "JX007"]) == 1
+
+
+def test_jx007_scoped_to_serve(tmp_path):
+    src = """\
+        import jax
+
+        def unplaced(x):
+            return jax.device_put(x)
+    """
+    rep = run_on(tmp_path, {"train/m.py": src, "cli/m.py": src})
+    assert "JX007" not in rules_hit(rep)
+    rep = run_on(tmp_path, {"serve/m.py": src})
+    assert "JX007" in rules_hit(rep)
+
+
+def test_jx007_alias_aware(tmp_path):
+    rep = run_on(tmp_path, {"serve/m.py": """\
+        import jax as j
+        from jax import device_put
+
+        def a(x):
+            return j.device_put(x)
+
+        def b(x):
+            return device_put(x)
+    """})
+    jx = [f for f in rep.findings if f.rule == "JX007"]
+    assert [f.line for f in jx] == [5, 8]
 
 
 # ---------------------------------------------------------------------------

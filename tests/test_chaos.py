@@ -284,3 +284,17 @@ def test_kill_and_resume_reaches_baseline_terminal(smoke, site):
     assert checks["conservation"], f"{site}: requests lost or duplicated"
     # the resumed run entered through the journaled phase, not from idle
     assert rec["resumed_from"] is not None, f"{site}: journal not consulted"
+
+
+def test_device_loss_drill_replaces_and_recovers(smoke):
+    """The kill-one-device drill on the virtual 8-device fleet: forced
+    re-placement onto survivors, bit-parity (or honest degradation) across
+    the loss, conservation, and fleet restoration."""
+    rec = smoke.run_device_loss()
+    assert "skipped" not in rec, rec  # conftest provides 8 devices
+    checks = rec["checks"]
+    assert checks["multi_device_before_loss"], rec
+    assert checks["plan_excludes_lost_device"], rec
+    assert checks["decisions_never_wrong"], "golden decisions moved"
+    assert checks["conservation"], "requests lost or duplicated"
+    assert checks["fleet_restored"] and checks["served_after_restore"], rec

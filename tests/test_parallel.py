@@ -25,6 +25,27 @@ def test_devices_available():
     assert len(jax.devices()) == 8
 
 
+def test_make_mesh_tolerates_non_factoring_device_counts():
+    """A grid that does not fit the fleet degrades to a 1-D data axis over
+    every device with a warning — never raises (a serving config moved
+    between hosts, or a chip lost mid-run, keeps a working mesh)."""
+    import warnings
+
+    devs = jax.devices()[:3]
+    with pytest.warns(RuntimeWarning, match="falling back"):
+        mesh = make_mesh(data=2, graph=2, devices=devs)
+    assert mesh.shape == {"data": 3, "graph": 1}
+    # an oversized graph axis degrades the same way
+    with pytest.warns(RuntimeWarning, match="falling back"):
+        mesh = make_mesh(graph=16)
+    assert mesh.shape == {"data": 8, "graph": 1}
+    # fitting grids stay exact and warning-free
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        mesh = make_mesh(data=2, graph=2, devices=jax.devices()[:4])
+    assert mesh.shape == {"data": 2, "graph": 2}
+
+
 def test_ring_apsp_matches_dense():
     rng = np.random.default_rng(0)
     n = 64

@@ -1,0 +1,125 @@
+"""serve/placement: greedy per-bucket device assignment from arrival rates.
+
+The properties that make placement safe to run inside the serving loop:
+determinism (same rates -> same plan, bit-stable across processes),
+divisibility (every bucket's device count divides the slot count, the
+sharded executor's compile-time invariant), hysteresis (small rate jitter
+never thrashes placements — each switch costs a compile), and forced
+re-planning on device loss (an invalid plan can never be held)."""
+
+import pytest
+
+from multihop_offload_tpu.serve.placement import (
+    PlacementPlan,
+    PlacementPlanner,
+    allowed_counts,
+    peak_device_load,
+    plan_assignments,
+)
+
+
+def test_allowed_counts_are_divisors():
+    assert allowed_counts(8, 6) == [1, 2, 4]
+    assert allowed_counts(8, 8) == [1, 2, 4, 8]
+    assert allowed_counts(4, 2) == [1, 2]
+    assert allowed_counts(5, 8) == [1, 5]
+
+
+def test_greedy_plan_is_deterministic_for_fixed_rates():
+    """The worked example the module docs promise: a hot bucket (10) and a
+    cold one (1) over six chips with eight slots — hot gets four chips,
+    cold absorbs the remaining two."""
+    plan = plan_assignments([10.0, 1.0], devices=list(range(6)), slots=8)
+    assert plan == ((0, 1, 2, 3), (4, 5))
+    # determinism: recomputing from the same rates is bit-identical
+    assert plan == plan_assignments([10.0, 1.0], list(range(6)), 8)
+
+
+def test_every_bucket_count_divides_slots():
+    for rates in ([1, 1, 1], [9, 3, 1], [0, 0, 5]):
+        plan = plan_assignments(rates, devices=list(range(8)), slots=8)
+        for devs in plan:
+            assert devs and 8 % len(devs) == 0
+
+
+def test_all_cold_spreads_evenly():
+    """Zero observed rates (startup) must not pile every chip on bucket 0:
+    the rate floor makes ties spread."""
+    plan = plan_assignments([0.0, 0.0], devices=list(range(4)), slots=4)
+    assert plan == ((0, 1), (2, 3))
+
+
+def test_fleet_smaller_than_ladder_shares_round_robin():
+    plan = plan_assignments([1.0, 2.0, 3.0], devices=[0, 1], slots=4)
+    assert plan == ((0,), (1,), (0,))
+    assert PlacementPlan(plan).buckets_on(0) == [0, 2]
+
+
+def test_peak_device_load():
+    plan = ((0, 1, 2, 3), (4, 5))
+    assert peak_device_load(plan, [10.0, 1.0]) == pytest.approx(2.5)
+    assert peak_device_load(plan, [4.0, 8.0]) == pytest.approx(4.0)
+
+
+def test_planner_stable_under_small_jitter():
+    """±5% arrival jitter around a settled rate vector must never switch
+    the plan: each switch costs a compile, and jitter is not a signal."""
+    p = PlacementPlanner(2, devices=list(range(6)), slots=8, alpha=1.0)
+    p.observe([100, 10])
+    settled = p.replan()
+    assert settled.assignments == ((0, 1, 2, 3), (4, 5))
+    switches = p.replans
+    for a, b in ((105, 10), (95, 11), (102, 9), (98, 10)):
+        p.observe([a, b])
+        assert p.replan().assignments == settled.assignments
+    assert p.replans == switches, "jitter thrashed the placement"
+
+
+def test_planner_switches_when_clearly_better():
+    """A genuine load inversion (hot and cold swap) must eventually win
+    through the hysteresis gate."""
+    p = PlacementPlanner(2, devices=list(range(6)), slots=8,
+                         alpha=1.0, hysteresis=0.2)
+    p.observe([100, 10])
+    assert p.replan().assignments == ((0, 1, 2, 3), (4, 5))
+    p.observe([10, 100])
+    flipped = p.replan()
+    assert flipped.assignments == ((0, 1), (2, 3, 4, 5))
+
+
+def test_device_removal_forces_replan():
+    """Losing a chip invalidates any plan referencing it: hysteresis cannot
+    hold an invalid plan, and the survivors cover every bucket."""
+    p = PlacementPlanner(2, devices=list(range(6)), slots=8, alpha=1.0)
+    p.observe([100, 10])
+    before = p.replan()
+    assert before.uses(5)
+    after = p.remove_device(5)
+    assert not after.uses(5)
+    assert all(devs for devs in after.assignments)
+    assert after.assignments == ((0, 1, 2, 3), (4,))
+    # recovery: the chip returns to the fleet and the old plan may win back
+    restored = p.add_device(5)
+    assert 5 in p.devices
+    assert all(8 % len(devs) == 0 for devs in restored.assignments)
+
+
+def test_remove_last_device_raises():
+    p = PlacementPlanner(1, devices=[0], slots=4)
+    with pytest.raises(ValueError):
+        p.remove_device(0)
+
+
+def test_observe_rejects_wrong_arity():
+    p = PlacementPlanner(2, devices=[0, 1], slots=4)
+    with pytest.raises(ValueError):
+        p.observe([1, 2, 3])
+
+
+def test_plan_describe_uses_device_ids():
+    class Dev:
+        def __init__(self, i):
+            self.id = i
+
+    plan = PlacementPlan(((Dev(0), Dev(1)), (Dev(2),)))
+    assert plan.describe() == {"0": [0, 1], "1": [2]}
