@@ -70,6 +70,47 @@ class TrainStepOutput:
     dst: jnp.ndarray            # (J,)
 
 
+# ---- device metrics for the training hot loop ---------------------------
+# One window per `gnn_train_step` call: loss first/second moments and the
+# per-episode gradient-norm histogram accumulate on device and flush at the
+# step's existing sync boundary (see `train/driver`), so the per-episode
+# distribution survives even when episodes fuse into one vmapped program.
+
+DM_GRAD_NORM = "mho_dev_train_grad_norm"
+DM_LOSS_CRITIC_SUM = "mho_dev_train_loss_critic_sum"
+DM_LOSS_CRITIC_SQ = "mho_dev_train_loss_critic_sq_sum"
+DM_LOSS_MSE_SUM = "mho_dev_train_loss_mse_sum"
+DM_EPISODES = "mho_dev_train_episodes_total"
+
+
+def train_devmetrics():
+    """Declare the train-step device metrics (frozen, trace-safe)."""
+    from multihop_offload_tpu.obs.devmetrics import DevMetrics
+
+    dm = DevMetrics()
+    dm.histogram(DM_GRAD_NORM, tuple(10.0 ** e for e in range(-6, 4)),
+                 "per-episode global gradient norm (decade buckets)")
+    dm.counter(DM_LOSS_CRITIC_SUM, "critic-loss first moment accumulator",
+               dtype=jnp.float32)  # fp32-island(loss moments accumulate wide by design)
+    dm.counter(DM_LOSS_CRITIC_SQ, "critic-loss second moment accumulator",
+               dtype=jnp.float32)  # fp32-island(second moment squares overflow bf16 fast)
+    dm.counter(DM_LOSS_MSE_SUM, "MSE-loss first moment accumulator",
+               dtype=jnp.float32)  # fp32-island(same wide-accumulator contract)
+    dm.counter(DM_EPISODES, "episodes accumulated into the moments")
+    return dm.freeze()
+
+
+def episode_grad_norms(grads) -> jnp.ndarray:
+    """(B,) global gradient norm per vmapped episode — fp32 accumulation
+    regardless of the parameter dtype."""
+    sq = None
+    for x in jax.tree_util.tree_leaves(grads):
+        x32 = jnp.asarray(x).astype(jnp.float32)  # fp32-island(norm accumulation is precision-critical)
+        s = jnp.sum(x32 * x32, axis=tuple(range(1, x32.ndim)))
+        sq = s if sq is None else sq + s
+    return jnp.sqrt(sq)
+
+
 def _critic_loss(
     inst: Instance, jobs: JobSet, routes_inc: jnp.ndarray, fp_fn=None,
     layout=None,

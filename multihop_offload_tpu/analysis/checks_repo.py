@@ -149,3 +149,48 @@ def check_ob002(mod: ModuleCtx) -> Iterator[Finding]:
                          "'# prof-ok(<why>)'"),
                 snippet=_snippet(mod, node),
             )
+
+
+# host-callback escape hatches: each call inside a compiled program stalls
+# the device on a host round trip — in-program telemetry goes through the
+# obs/devmetrics accumulator pytree instead
+_OB003_CALLS = {
+    "jax.debug.print",
+    "jax.debug.callback",
+    "jax.experimental.io_callback",
+    "jax.io_callback",
+}
+
+
+@rule(
+    id="OB003", severity="error",
+    scope="jit-reachable functions outside obs/ (the obs layer owns the "
+          "deliberate host bridges)",
+    waiver="# devcb-ok(",
+    doc=("jax.debug.print / jax.debug.callback / io_callback in "
+         "jit-reachable code — each host callback stalls the device; "
+         "accumulate through obs.devmetrics instead"),
+    exempt_dirs=("obs",),
+)
+def check_ob003(mod: ModuleCtx) -> Iterator[Finding]:
+    project = getattr(mod, "project", None)
+    if project is None:
+        return
+    for qn, fi in mod.functions.items():
+        if not project.is_reachable(mod, qn):
+            continue
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            canon = mod.canonical(node.func) if isinstance(
+                node.func, (ast.Name, ast.Attribute)) else None
+            if canon in _OB003_CALLS:
+                yield Finding(
+                    rule="OB003", path=mod.path, line=node.lineno,
+                    message=(f"host callback {canon}() in jit-reachable "
+                             "code — the device stalls on every invocation; "
+                             "thread an obs.devmetrics accumulator through "
+                             "the program instead, or waive with "
+                             "'# devcb-ok(<why>)'"),
+                    snippet=_snippet(mod, node),
+                )

@@ -186,6 +186,39 @@ def run_scenarios(cfg: Config, steady: bool = True) -> dict:
         "mean_packet_delay_dl": float(np.nanmean(mean_delay[:, j:]))
         if np.isfinite(mean_delay[:, j:]).any() else None,
     }
+    if sim.last_devmetrics is not None:
+        from multihop_offload_tpu.sim.step import (
+            DM_DELIVERED, DM_DROP_ARR, DM_DROP_CAP, DM_DROP_FWD,
+            DM_GENERATED, DM_QUEUE_DEPTH,
+        )
+
+        f = sim.last_devmetrics
+        dev_gen = int(f[DM_GENERATED])
+        dev_del = int(f[DM_DELIVERED])
+        dev_drop = int(f[DM_DROP_FWD] + f[DM_DROP_ARR] + f[DM_DROP_CAP])
+        h = f[DM_QUEUE_DEPTH]
+        summary["devmetrics"] = {
+            "generated": dev_gen,
+            "delivered": dev_del,
+            "dropped": dev_drop,
+            "dropped_by_reason": {
+                "no_route_forward": int(f[DM_DROP_FWD]),
+                "no_route_arrival": int(f[DM_DROP_ARR]),
+                "capacity": int(f[DM_DROP_CAP]),
+            },
+            "queue_depth": {
+                "count": h["count"], "mean":
+                (h["sum"] / h["count"]) if h["count"] else None,
+                "max": h["max"], "counts": h["counts"],
+            },
+            # device-side counters vs the terminal SimState conservation
+            # counters — must agree bit for bit (same masks, same slots)
+            "matches_state": bool(
+                dev_gen == int(generated.sum())
+                and dev_del == int(delivered.sum())
+                and dev_drop == int(dropped.sum())
+            ),
+        }
     return summary
 
 
@@ -203,6 +236,13 @@ def run_smoke(cfg: Config) -> dict:
             dataclasses.replace(smoke_cfg, sim_policy=pol), steady=False
         )
         assert s["conservation_ok"], f"conservation violated under {pol}"
+        assert s["devmetrics"]["matches_state"], (
+            f"devmetrics counters diverge from SimState under {pol}: "
+            f"{s['devmetrics']}"
+        )
+        assert s["devmetrics"]["queue_depth"]["count"] > 0, (
+            f"empty queue-depth histogram under {pol}"
+        )
         results[pol] = s
     results["ok"] = True
     return results

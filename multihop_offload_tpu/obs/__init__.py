@@ -17,6 +17,14 @@ captured-experience stream for distribution shift, and `flightrec` keeps
 a bounded ring of tick diagnostics dumped as a debug bundle on breach
 (`mho-health` drives the closed-loop proof).
 
+`devmetrics` extends the registry INTO compiled programs: declared-once
+metric accumulators (counters / gauges / histograms) live as a pytree
+threaded through `scan`/`vmap` bodies, reduce across shards like any
+program output, and flush into the registry at the sync boundaries the
+prof layer already accounts at — per-slot/per-episode facts with zero
+new host syncs (the OB003 lint rule polices the host-callback escape
+hatch this replaces).
+
 The prof layer (`prof`, `memwatch`, `mho-prof`) adds per-program cost
 attribution: every jitted entry point registers its compiled program's
 AOT cost/memory analysis and accounts calls + device seconds, driving
@@ -32,6 +40,10 @@ from multihop_offload_tpu.obs.events import (  # noqa: F401
     run_manifest,
     segment_paths,
     set_run_log,
+)
+from multihop_offload_tpu.obs.devmetrics import (  # noqa: F401
+    DevMetrics,
+    pow2_buckets,
 )
 from multihop_offload_tpu.obs.memwatch import (  # noqa: F401
     MemWatch,

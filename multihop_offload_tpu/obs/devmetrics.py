@@ -1,0 +1,359 @@
+"""Device-native telemetry: metric accumulators inside jitted programs.
+
+Everything the host registry observes is sampled at a sync boundary —
+once a loop fuses into one `lax.scan` (the sim's scan-of-scans, the
+Anakin-style colocated learner the ROADMAP targets), host spans and
+counters go blind to per-iteration dynamics.  This module closes the gap
+with metric accumulators that live ON the device as a pytree:
+
+  * **declared once at build time** — a `DevMetrics` object is assembled
+    next to the precision/layout policies and frozen before the first
+    trace, so the set of metrics, histogram boundaries and labels are
+    compile-time constants that can never cause a retrace;
+  * **updated with pure `jnp` ops** — `inc` / `set` / `observe` take the
+    accumulator pytree and return a new one, usable anywhere inside
+    `jit` / `vmap` / `lax.scan` bodies (scatter-adds on fixed shapes, no
+    host callbacks — the OB003 lint rule polices the callback escape
+    hatch);
+  * **reduced like any other program output** — under a sharded program
+    the accumulators are plain arrays, so summing them over the batch
+    axis with replicated outputs lowers to the same psum-style ICI
+    allreduce GSPMD emits for any fleet metric (`serve/sharded.py` rides
+    them on its existing fleet-metrics reduction);
+  * **flushed at existing sync boundaries** — `flush` converts a window's
+    accumulator pytree (host-fetched alongside the outputs the caller
+    already pulls) into the process-wide `obs.registry`, so devmetrics
+    adds zero new host syncs and the run report / Prometheus exposition
+    see device-side facts through the same pipe as everything else.
+
+Accumulator semantics: a pytree from `init()` represents ONE window that
+starts at zero — counters sum Bernoulli masks / amounts, gauges keep the
+last value written, histograms scatter weighted observations into fixed
+buckets (Prometheus `le` semantics, +Inf tail) with exact sum/min/max.
+`flush` treats any leading axes (vmap lanes, shards) as replicas to merge:
+counters and bucket counts sum, min/max reduce, gauges average.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional, Tuple
+
+import numpy as np
+
+from multihop_offload_tpu.obs.registry import MetricRegistry, registry
+
+_KINDS = ("c", "g", "h")
+
+
+class _Decl:
+    __slots__ = ("kind", "key", "name", "help", "labels", "buckets", "dtype")
+
+    def __init__(self, kind, key, name, help_, labels, buckets, dtype):
+        self.kind = kind
+        self.key = key
+        self.name = name
+        self.help = help_
+        self.labels = labels
+        self.buckets = buckets
+        self.dtype = dtype
+
+
+def _default_key(name: str, labels: Dict[str, str]) -> str:
+    if not labels:
+        return name
+    return name + "{" + ",".join(f"{k}={v}" for k, v in sorted(labels.items())) + "}"
+
+
+class DevMetrics:
+    """A build-time metric declaration + the pure update/flush ops over it.
+
+    Usage::
+
+        dm = DevMetrics()
+        DROPS = dm.counter("mho_dev_sim_dropped_total", reason="capacity")
+        DEPTH = dm.histogram("mho_dev_sim_queue_depth", buckets=(0, 1, 2, 4))
+        dm.freeze()
+
+        dev = dm.init()                       # zeros pytree (trace-safe)
+        dev = dm.inc(dev, DROPS, mask)        # inside scan/vmap bodies
+        dev = dm.observe(dev, DEPTH, depths)
+        ...
+        dm.flush(dev)                  # at the caller's sync boundary
+                                       # (one packed transfer, see _fetch)
+
+    Declaration methods return the string KEY the update ops take; two
+    declarations of the same metric name with different static labels get
+    distinct keys (and flush into distinct registry series).
+    """
+
+    def __init__(self):
+        self._decls: Dict[str, _Decl] = {}
+        self._frozen = False
+        self._pack = None  # lazily-built jitted bulk-fetch packer
+
+    # ---- declaration (host, build time) ---------------------------------
+
+    def _declare(self, kind, name, help_, labels, buckets, dtype, key):
+        if self._frozen:
+            raise RuntimeError(
+                "DevMetrics is frozen — declare every metric before the "
+                "first init()/trace (the declaration is a compile-time "
+                "constant)"
+            )
+        key = key or _default_key(name, labels)
+        if key in self._decls:
+            raise ValueError(f"duplicate devmetric key '{key}'")
+        self._decls[key] = _Decl(kind, key, name, help_,
+                                 dict(labels), buckets, dtype)
+        return key
+
+    def counter(self, name: str, help_: str = "", *, dtype=None,
+                key: Optional[str] = None, **labels) -> str:
+        """Monotone sum accumulator.  Defaults to int32 (exact against the
+        sim's int32 conservation counters); pass a float dtype for sums of
+        real-valued amounts (loss moments)."""
+        import jax.numpy as jnp
+
+        return self._declare("c", name, help_, labels, None,
+                             dtype or jnp.int32, key)
+
+    def gauge(self, name: str, help_: str = "", *, dtype=None,
+              key: Optional[str] = None, **labels) -> str:
+        """Last-value-wins accumulator (flush averages replicas/lanes)."""
+        import jax.numpy as jnp
+
+        return self._declare("g", name, help_, labels, None,
+                             dtype or jnp.float32, key)
+
+    def histogram(self, name: str, buckets: Iterable[float],
+                  help_: str = "", *, dtype=None,
+                  key: Optional[str] = None, **labels) -> str:
+        """Fixed-bucket histogram (`le` boundaries + implicit +Inf tail)
+        with exact per-window sum/min/max alongside the bucket counts."""
+        import jax.numpy as jnp
+
+        b = tuple(sorted(float(x) for x in buckets))
+        if not b:
+            raise ValueError("histogram needs at least one bucket boundary")
+        return self._declare("h", name, help_, labels, b,
+                             dtype or jnp.float32, key)
+
+    def freeze(self) -> "DevMetrics":
+        self._frozen = True
+        return self
+
+    # ---- device-side pytree ---------------------------------------------
+
+    def init(self) -> dict:
+        """Zeros accumulator pytree for one window.  Freezes the
+        declaration: the pytree structure is now a fixed treedef."""
+        import jax.numpy as jnp
+
+        self._frozen = True
+        c, g, h = {}, {}, {}
+        for d in self._decls.values():
+            if d.kind == "c":
+                c[d.key] = jnp.zeros((), d.dtype)
+            elif d.kind == "g":
+                g[d.key] = jnp.zeros((), d.dtype)
+            else:
+                h[d.key] = {
+                    "counts": jnp.zeros((len(d.buckets) + 1,), jnp.int32),
+                    "sum": jnp.zeros((), d.dtype),
+                    "min": jnp.full((), jnp.inf, d.dtype),
+                    "max": jnp.full((), -jnp.inf, d.dtype),
+                }
+        return {"c": c, "g": g, "h": h}
+
+    def _decl(self, key: str, kind: str) -> _Decl:
+        d = self._decls.get(key)
+        if d is None or d.kind != kind:
+            raise KeyError(f"no {kind!r} devmetric with key '{key}'")
+        return d
+
+    def inc(self, dev: dict, key: str, amount=1) -> dict:
+        """Counter add: `amount` may be a scalar, a bool mask (counts the
+        True entries) or any array (summed).  Pure — returns a new pytree."""
+        import jax.numpy as jnp
+
+        d = self._decl(key, "c")
+        # explicit accumulator dtype: under x64 an int32 sum would promote
+        # to int64 and break the scan-carry type match
+        amt = jnp.sum(jnp.asarray(amount).astype(d.dtype), dtype=d.dtype)
+        c = dict(dev["c"])
+        c[key] = dev["c"][key] + amt
+        return {"c": c, "g": dev["g"], "h": dev["h"]}
+
+    def set(self, dev: dict, key: str, value) -> dict:
+        """Gauge write (last value wins within the window)."""
+        import jax.numpy as jnp
+
+        d = self._decl(key, "g")
+        g = dict(dev["g"])
+        g[key] = jnp.asarray(value).astype(d.dtype)
+        return {"c": dev["c"], "g": g, "h": dev["h"]}
+
+    def observe(self, dev: dict, key: str, values, weights=None) -> dict:
+        """Histogram scatter: bucket every element of `values` (any shape);
+        `weights` (same shape, int) masks/weights observations — weight 0
+        entries leave counts AND sum/min/max untouched."""
+        import jax.numpy as jnp
+
+        d = self._decl(key, "h")
+        v = jnp.ravel(jnp.asarray(values)).astype(d.dtype)
+        if weights is None:
+            w = jnp.ones(v.shape, jnp.int32)
+        else:
+            w = jnp.ravel(jnp.asarray(weights)).astype(jnp.int32)
+        bounds = jnp.asarray(d.buckets, d.dtype)
+        # first boundary >= v  ==  Prometheus `v <= le` bucketing; beyond
+        # the last boundary lands in the +Inf tail slot
+        idx = jnp.searchsorted(bounds, v, side="left")
+        h = dev["h"][key]
+        live = w > 0
+        nb = h["counts"].shape[-1]
+        if v.size * nb <= 4096:
+            # small batches (the hot-loop case): a one-hot reduction beats
+            # XLA's serialized scatter inside scan bodies by a wide margin
+            delta = jnp.sum(
+                (idx[:, None] == jnp.arange(nb)[None, :]) * w[:, None],
+                axis=0, dtype=h["counts"].dtype,
+            )
+            counts = h["counts"] + delta
+        else:
+            counts = h["counts"].at[idx].add(w)
+        new = {
+            "counts": counts,
+            "sum": h["sum"] + jnp.sum(v * w.astype(d.dtype)),
+            "min": jnp.minimum(h["min"],
+                               jnp.min(jnp.where(live, v, jnp.inf))),
+            "max": jnp.maximum(h["max"],
+                               jnp.max(jnp.where(live, v, -jnp.inf))),
+        }
+        hh = dict(dev["h"])
+        hh[key] = new
+        return {"c": dev["c"], "g": dev["g"], "h": hh}
+
+    def merge(self, a: dict, b: dict) -> dict:
+        """Combine two windows (pure jnp, usable in-program): counters and
+        bucket counts add, min/max reduce, gauges take `b` (later wins)."""
+        import jax.numpy as jnp
+
+        c = {k: a["c"][k] + b["c"][k] for k in a["c"]}
+        g = dict(b["g"])
+        h = {}
+        for k, ha in a["h"].items():
+            hb = b["h"][k]
+            h[k] = {
+                "counts": ha["counts"] + hb["counts"],
+                "sum": ha["sum"] + hb["sum"],
+                "min": jnp.minimum(ha["min"], hb["min"]),
+                "max": jnp.maximum(ha["max"], hb["max"]),
+            }
+        return {"c": c, "g": g, "h": h}
+
+    # ---- host-side flush -------------------------------------------------
+
+    def _fetch(self, dev):
+        """Bulk host fetch of a device-resident accumulator pytree.
+
+        A per-leaf `device_get` pays a fixed dispatch + transfer-setup cost
+        per array (~50us each on CPU hosts) — at a dozen-odd accumulators
+        that fixed cost dwarfs the bytes moved.  Pack every leaf into one
+        int and one float vector in a single compiled op, transfer those
+        two, and split host-side (widening casts only, so the round trip
+        is exact)."""
+        import jax
+        import jax.numpy as jnp
+
+        leaves, treedef = jax.tree_util.tree_flatten(dev)
+        if not leaves or not all(isinstance(x, jax.Array) for x in leaves):
+            return dev  # already host-side (or empty): nothing to fetch
+        if self._pack is None:
+            @jax.jit
+            def pack(ls):
+                ints = [jnp.ravel(x) for x in ls
+                        if jnp.issubdtype(x.dtype, jnp.integer)]
+                flts = [jnp.ravel(x) for x in ls
+                        if not jnp.issubdtype(x.dtype, jnp.integer)]
+                return (
+                    jnp.concatenate(ints) if ints else jnp.zeros((0,)),
+                    jnp.concatenate(flts) if flts else jnp.zeros((0,)),
+                )
+
+            self._pack = pack
+        ints, flts = jax.device_get(self._pack(tuple(leaves)))
+        out, io, fo = [], 0, 0
+        for x in leaves:
+            n = int(np.prod(x.shape, dtype=np.int64))
+            if jnp.issubdtype(x.dtype, jnp.integer):
+                out.append(np.asarray(
+                    ints[io:io + n], x.dtype).reshape(x.shape))
+                io += n
+            else:
+                out.append(np.asarray(
+                    flts[fo:fo + n], x.dtype).reshape(x.shape))
+                fo += n
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def flush(self, dev, reg: Optional[MetricRegistry] = None,
+              **labels) -> dict:
+        """Merge one window's (host-fetched) accumulators into the metric
+        registry and return the merged plain-python values.
+
+        `dev` leaves may carry leading axes (vmap lanes, per-shard copies):
+        counters and bucket counts sum over them, histogram min/max reduce,
+        gauges average.  `labels` are appended to every series (shard/
+        bucket attribution at the flush site).  Call this at a sync
+        boundary the caller already pays for — device-resident leaves are
+        fetched here in one packed transfer (`_fetch`), or pass `dev`
+        pre-fetched if it already rode a bulk `device_get` with the
+        program's real outputs."""
+        reg = reg if reg is not None else registry()
+        dev = self._fetch(dev)
+        out = {}
+        for d in self._decls.values():
+            lab = {**d.labels, **labels}
+            if d.kind == "c":
+                total = float(np.sum(np.asarray(dev["c"][d.key])))
+                reg.counter(d.name, d.help).inc(total, **lab)
+                out[d.key] = total
+            elif d.kind == "g":
+                val = float(np.mean(np.asarray(dev["g"][d.key])))
+                reg.gauge(d.name, d.help).set(val, **lab)
+                out[d.key] = val
+            else:
+                h = dev["h"][d.key]
+                counts = np.asarray(h["counts"], np.int64)
+                counts = counts.reshape(-1, counts.shape[-1]).sum(axis=0)
+                total = int(counts.sum())
+                s = float(np.sum(np.asarray(h["sum"])))
+                mn = float(np.min(np.asarray(h["min"]))) if total else None
+                mx = float(np.max(np.asarray(h["max"]))) if total else None
+                reg.histogram(d.name, d.help, buckets=d.buckets) \
+                    .observe_bucketed(counts.tolist(), s, mn, mx, **lab)
+                out[d.key] = {
+                    "count": total, "sum": s, "min": mn, "max": mx,
+                    "counts": counts.tolist(),
+                }
+        return out
+
+    # ---- introspection ---------------------------------------------------
+
+    def buckets_of(self, key: str) -> Tuple[float, ...]:
+        return self._decl(key, "h").buckets
+
+    def keys(self) -> Tuple[str, ...]:
+        return tuple(self._decls)
+
+
+def pow2_buckets(hi: int) -> Tuple[float, ...]:
+    """Power-of-two occupancy ladder 0,1,2,4,...,hi — the natural boundary
+    set for queue depths bounded by a ring-buffer capacity."""
+    out = [0.0, 1.0]
+    b = 2
+    while b < hi:
+        out.append(float(b))
+        b *= 2
+    out.append(float(hi))
+    return tuple(dict.fromkeys(out))

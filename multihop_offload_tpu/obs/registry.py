@@ -11,8 +11,10 @@ scraper (or a golden test) can consume the same state.
 from __future__ import annotations
 
 import math
+import os
 import threading
-from typing import Dict, Iterable, Optional, Tuple
+import warnings
+from typing import Dict, Iterable, List, Optional, Tuple
 
 # latency-shaped default buckets (seconds), Prometheus-style, +Inf implicit
 DEFAULT_BUCKETS = (
@@ -48,6 +50,25 @@ LATENCY_BUCKETS = log_buckets(0.001, 60.0, per_decade=4)
 
 _LabelKey = Tuple[Tuple[str, str], ...]
 
+# per-metric label-set (series) cap: devmetrics flushes stamp shard/bucket
+# labels, and an unbounded label value (a request id, a device string that
+# varies per restart) would grow the registry without limit.  Series beyond
+# the cap are dropped with a one-time warning per metric and counted in
+# `mho_registry_dropped_labelsets_total{metric=...}`.
+DEFAULT_MAX_LABELSETS = 256
+DROPPED_LABELSETS = "mho_registry_dropped_labelsets_total"
+
+
+def max_labelsets() -> int:
+    """Per-metric distinct-label-set cap (env `MHO_REGISTRY_MAX_LABELSETS`,
+    default 256).  Read lazily so tests and operators can retune a live
+    process; only consulted when a NEW series would be created."""
+    try:
+        return int(os.environ.get("MHO_REGISTRY_MAX_LABELSETS",
+                                  DEFAULT_MAX_LABELSETS))
+    except ValueError:
+        return DEFAULT_MAX_LABELSETS
+
 
 def _label_key(labels: Dict[str, object]) -> _LabelKey:
     return tuple(sorted((k, str(v)) for k, v in labels.items()))
@@ -68,11 +89,33 @@ class _Metric:
 
     kind = "untyped"
 
-    def __init__(self, name: str, help_: str, lock: threading.RLock):
+    def __init__(self, name: str, help_: str, lock: threading.RLock,
+                 registry: Optional["MetricRegistry"] = None):
         self.name = name
         self.help = help_
         self._lock = lock
+        self._registry = registry
         self._series: Dict[_LabelKey, object] = {}
+        self._warned_cap = False
+
+    def _admit(self, key: _LabelKey) -> bool:
+        """Cardinality gate, called under the lock before creating a NEW
+        series.  Existing series always pass (updates are never lost to
+        the cap — only unbounded growth is)."""
+        if key in self._series or len(self._series) < max_labelsets():
+            return True
+        if not self._warned_cap:
+            self._warned_cap = True
+            warnings.warn(
+                f"metric '{self.name}' reached the {max_labelsets()} "
+                "label-set cap (MHO_REGISTRY_MAX_LABELSETS); further label "
+                "combinations are dropped and counted in "
+                f"{DROPPED_LABELSETS}",
+                RuntimeWarning, stacklevel=3,
+            )
+        if self._registry is not None:
+            self._registry._note_dropped_labelset(self.name)
+        return False
 
 
 class Counter(_Metric):
@@ -83,6 +126,8 @@ class Counter(_Metric):
             raise ValueError("counters only go up")
         key = _label_key(labels)
         with self._lock:
+            if not self._admit(key):
+                return
             self._series[key] = self._series.get(key, 0.0) + amount
 
     def value(self, **labels) -> float:
@@ -106,12 +151,17 @@ class Gauge(_Metric):
     kind = "gauge"
 
     def set(self, value: float, **labels) -> None:
+        key = _label_key(labels)
         with self._lock:
-            self._series[_label_key(labels)] = float(value)
+            if not self._admit(key):
+                return
+            self._series[key] = float(value)
 
     def inc(self, amount: float = 1.0, **labels) -> None:
         key = _label_key(labels)
         with self._lock:
+            if not self._admit(key):
+                return
             self._series[key] = self._series.get(key, 0.0) + amount
 
     def value(self, **labels) -> Optional[float]:
@@ -140,8 +190,9 @@ class Histogram(_Metric):
     kind = "histogram"
 
     def __init__(self, name: str, help_: str, lock: threading.RLock,
-                 buckets: Iterable[float] = DEFAULT_BUCKETS):
-        super().__init__(name, help_, lock)
+                 buckets: Iterable[float] = DEFAULT_BUCKETS,
+                 registry: Optional["MetricRegistry"] = None):
+        super().__init__(name, help_, lock, registry=registry)
         self.buckets = tuple(sorted(float(b) for b in buckets))
 
     def observe(self, value: float, **labels) -> None:
@@ -150,6 +201,8 @@ class Histogram(_Metric):
         with self._lock:
             s = self._series.get(key)
             if s is None:
+                if not self._admit(key):
+                    return
                 s = self._series[key] = _HistSeries(len(self.buckets))
             s.count += 1
             s.sum += v
@@ -161,6 +214,36 @@ class Histogram(_Metric):
                     break
             else:
                 s.bucket_counts[-1] += 1
+
+    def observe_bucketed(self, bucket_counts: List[int], sum_: float,
+                         min_: Optional[float] = None,
+                         max_: Optional[float] = None, **labels) -> None:
+        """Merge a PRE-BUCKETED window of observations (a device-side
+        histogram flushed by `obs.devmetrics`): per-bucket counts must
+        match this histogram's boundaries exactly (+Inf tail included),
+        so merged series stay valid under the cumulative text exposition.
+        min/max are optional because an empty window has neither."""
+        if len(bucket_counts) != len(self.buckets) + 1:
+            raise ValueError(
+                f"bucket mismatch: got {len(bucket_counts)} counts for "
+                f"{len(self.buckets)} boundaries (+Inf tail) of '{self.name}'"
+            )
+        n = int(sum(bucket_counts))
+        key = _label_key(labels)
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                if not self._admit(key):
+                    return
+                s = self._series[key] = _HistSeries(len(self.buckets))
+            s.count += n
+            s.sum += float(sum_)
+            if n > 0 and min_ is not None:
+                s.min = min(s.min, float(min_))
+            if n > 0 and max_ is not None:
+                s.max = max(s.max, float(max_))
+            for i, c in enumerate(bucket_counts):
+                s.bucket_counts[i] += int(c)
 
     def stats(self, **labels) -> Optional[dict]:
         with self._lock:
@@ -236,12 +319,25 @@ class MetricRegistry:
         with self._lock:
             m = self._metrics.get(name)
             if m is None:
-                m = self._metrics[name] = cls(name, help_, self._lock, **kw)
+                m = self._metrics[name] = cls(name, help_, self._lock,
+                                              registry=self, **kw)
             elif not isinstance(m, cls):
                 raise TypeError(
                     f"metric '{name}' already registered as {m.kind}"
                 )
             return m
+
+    def _note_dropped_labelset(self, metric_name: str) -> None:
+        """Account one label-set dropped by a metric's cardinality cap.
+        The accounting counter never notes drops against itself — that
+        would recurse when the process has more than the cap's worth of
+        distinct capped metrics."""
+        if metric_name == DROPPED_LABELSETS:
+            return
+        self.counter(
+            DROPPED_LABELSETS,
+            "label-sets dropped by the per-metric cardinality cap",
+        ).inc(metric=metric_name)
 
     def counter(self, name: str, help_: str = "") -> Counter:
         return self._get(Counter, name, help_)

@@ -269,6 +269,39 @@ def render_report(path: str) -> str:
                          f"{last_tick['queue_depth']}")
         lines.append("")
 
+    # device-native telemetry (obs/devmetrics): in-program accumulators
+    # flushed into the registry — absent entirely in runs without
+    # instrumented hot loops, so the section degrades to nothing
+    dev_names = sorted(n for n in metrics if n.startswith("mho_dev_"))
+    if dev_names:
+        lines.append("device metrics (in-program)")
+        hist_rows = []
+        for name in dev_names:
+            m = metrics[name]
+            if m.get("kind") == "histogram":
+                for lab, s in sorted((m.get("series") or {}).items()):
+                    if not isinstance(s, dict):
+                        continue
+                    cnt = int(s.get("count") or 0)
+                    hist_rows.append([
+                        f"{name}{'' if not lab else lab}", cnt,
+                        _fmt_opt(s.get("sum"), "{:.4g}"),
+                        _fmt_opt((s.get("sum") or 0.0) / cnt if cnt else None,
+                                 "{:.4g}"),
+                        _fmt_opt(s.get("min"), "{:.4g}"),
+                        _fmt_opt(s.get("max"), "{:.4g}"),
+                    ])
+            else:
+                for lab, v in sorted(_counter_by_label(metrics, name).items()):
+                    tag = f"{name}{'' if lab == '(total)' else lab}"
+                    val = int(v) if float(v) == int(v) else round(v, 4)
+                    lines.append(f"  {tag:<58} {val}")
+        if hist_rows:
+            lines += ["  " + ln for ln in _table(
+                ["histogram", "count", "sum", "mean", "min", "max"],
+                hist_rows)]
+        lines.append("")
+
     loop_counters = {
         name: _counter_by_label(metrics, name) for name in metrics
         if name.startswith("mho_loop_")

@@ -32,6 +32,46 @@ from multihop_offload_tpu.serve.bucketing import ShapeBuckets
 from multihop_offload_tpu.train import checkpoints as ckpt_lib
 
 
+# ---- device metrics for the decision hot path ----------------------------
+# One window per dispatch: decision counters and the delay-estimate
+# histogram accumulate inside the fused program and ride the bulk
+# device->host fetch `run` already performs.
+
+DM_SERVE_DELAY_EST = "mho_dev_serve_delay_est"
+DM_SERVE_LOCAL = "mho_dev_serve_decisions_total{decision=local}"
+DM_SERVE_OFFLOAD = "mho_dev_serve_decisions_total{decision=offload}"
+
+
+def serve_devmetrics():
+    """Declare the serve-path device metrics (frozen, trace-safe)."""
+    from multihop_offload_tpu.obs.devmetrics import DevMetrics
+
+    dm = DevMetrics()
+    for decision in ("local", "offload"):
+        dm.counter("mho_dev_serve_decisions_total",
+                   "offloading decisions, counted in-program per dispatch",
+                   decision=decision)
+    dm.histogram(DM_SERVE_DELAY_EST, tuple(10.0 ** e for e in range(-2, 5)),
+                 "decision-time per-job delay estimate (decade buckets)")
+    return dm.freeze()
+
+
+def observe_decisions(dm, out, mask):
+    """One dispatch's decision telemetry from the step outputs — pure jnp,
+    shared by the single-device and mesh-sharded executors so both report
+    identical facts.  `mask` keeps pad jobs out of every series."""
+    import jax.numpy as jnp
+
+    _, is_local, delay_est, _ = out
+    live = mask
+    dev = dm.init()
+    dev = dm.inc(dev, DM_SERVE_LOCAL, is_local & live)
+    dev = dm.inc(dev, DM_SERVE_OFFLOAD, (~is_local) & live)
+    dev = dm.observe(dev, DM_SERVE_DELAY_EST, delay_est,
+                     weights=live.astype(jnp.int32))
+    return dev
+
+
 def param_signature(tree):
     """Structural signature of a param tree: (path, shape, dtype) per leaf.
 
@@ -73,24 +113,39 @@ class BucketExecutor:
         # packer builds sparse-leaf instances and the steps close over the
         # policy, so the knob never appears as a traced value
         self.layout = resolve_layout(layout)
+        self.devmetrics = serve_devmetrics()
+        self.last_devmetrics: Optional[dict] = None
+        dm = self.devmetrics
         self._steps = {}
         self._closures = {}
         for b, pad in enumerate(buckets.pads):
             gnn_step, baseline_step = self._bucket_closures(
                 pad, apsp_impl, fp_impl, prob
             )
+            # the RAW closures stay devmetrics-free: they are the shared
+            # decision math the sharded executor compiles too (bit-parity);
+            # the accumulators wrap around them per execution path
             self._closures[b] = (gnn_step, baseline_step)
+
+            def gnn_dev(variables, binst, bjobs, keys, _g=gnn_step):
+                out = _g(variables, binst, bjobs, keys)
+                return out, observe_decisions(dm, out, bjobs.mask)
+
+            def baseline_dev(binst, bjobs, keys, _b=baseline_step):
+                out = _b(binst, bjobs, keys)
+                return out, observe_decisions(dm, out, bjobs.mask)
+
             # each bucket program registers with the prof layer on its
             # first dispatch (AOT compile + cost/memory analysis); the
             # compiled executable then serves every later tick
             self._steps[b] = (
                 obs_prof.wrap(
                     f"serve/bucket{b}/gnn",
-                    jax.jit(gnn_step),  # retrace-ok(one program per bucket, built once at construction)
+                    jax.jit(gnn_dev),  # retrace-ok(one program per bucket, built once at construction)
                 ),
                 obs_prof.wrap(
                     f"serve/bucket{b}/baseline",
-                    jax.jit(baseline_step),  # retrace-ok(same: the loop IS the build)
+                    jax.jit(baseline_dev),  # retrace-ok(same: the loop IS the build)
                 ),
             )
 
@@ -141,8 +196,8 @@ class BucketExecutor:
         gnn, baseline = self._steps[bucket]
         step = baseline if degraded else gnn
         t0 = time.perf_counter()  # nondet-ok(device-time accounting is a measurement)
-        out = (baseline(binst, bjobs, keys) if degraded
-               else gnn(self.variables, binst, bjobs, keys))
+        out, dev = (baseline(binst, bjobs, keys) if degraded
+                    else gnn(self.variables, binst, bjobs, keys))
         self.dispatch_count += 1
         if request_ids:
             obs_trace.hop(
@@ -151,9 +206,14 @@ class BucketExecutor:
                 program="baseline" if degraded else "gnn",
                 step=self.loaded_step,
             )
-        host = tuple(np.asarray(x) for x in jax.device_get(out))
+        host_out, host_dev = jax.device_get((out, dev))
+        host = tuple(np.asarray(x) for x in host_out)
         # the bulk fetch above IS the sync boundary: dispatch-to-fetch wall
-        # time is this program's device window
+        # time is this program's device window (the devmetrics window rides
+        # the same fetch — no extra round trip)
+        self.last_devmetrics = self.devmetrics.flush(
+            host_dev, bucket=str(bucket)
+        )
         step.account(time.perf_counter() - t0)  # nondet-ok(same measurement)
         return host
 

@@ -38,7 +38,10 @@ from multihop_offload_tpu.obs import prof as obs_prof
 from multihop_offload_tpu.obs import trace as obs_trace
 from multihop_offload_tpu.parallel.mesh import make_mesh
 from multihop_offload_tpu.serve.bucketing import ShapeBuckets
-from multihop_offload_tpu.serve.executor import BucketExecutor
+from multihop_offload_tpu.serve.executor import (
+    BucketExecutor,
+    observe_decisions,
+)
 from multihop_offload_tpu.serve.placement import PlacementPlan
 
 
@@ -139,22 +142,27 @@ class ShardedBucketExecutor(BucketExecutor):
         replicated = NamedSharding(mesh, PartitionSpec())
         batched = NamedSharding(mesh, PartitionSpec("data"))
         gnn_raw, baseline_raw = self._closures[bucket]
+        dm = self.devmetrics
 
-        def fleet_metrics(out):
+        def fleet_metrics(out, mask):
             # the ONE cross-shard collective: scalar reductions over the
             # batch axis (replicated outputs -> an ICI allreduce when the
-            # inputs are sharded); decisions themselves never communicate
+            # inputs are sharded); decisions themselves never communicate.
+            # The devmetrics accumulators are more scalars-from-the-sharded-
+            # batch, so they lower into the SAME allreduce class — no new
+            # collective kind enters the program
             _, _, delay_est, job_total = out
             return {"job_total_sum": jnp.sum(job_total),
-                    "delay_est_max": jnp.max(delay_est)}
+                    "delay_est_max": jnp.max(delay_est),
+                    "dev": observe_decisions(dm, out, mask)}
 
         def gnn_step(variables, binst, bjobs, keys):
             out = gnn_raw(variables, binst, bjobs, keys)
-            return out, fleet_metrics(out)
+            return out, fleet_metrics(out, bjobs.mask)
 
         def baseline_step(binst, bjobs, keys):
             out = baseline_raw(binst, bjobs, keys)
-            return out, fleet_metrics(out)
+            return out, fleet_metrics(out, bjobs.mask)
 
         labels = {"shard": str(len(devs)), "devices": _devices_label(devs)}
         steps = (
@@ -212,8 +220,15 @@ class ShardedBucketExecutor(BucketExecutor):
         host = tuple(np.asarray(x) for x in jax.device_get(out))
         # one bulk fetch is still the sync boundary; the metric scalars ride
         # along so reading them adds no extra device round trip
+        dev = metrics.pop("dev", None)
         self.last_metrics = {
             k: float(np.asarray(jax.device_get(v))) for k, v in metrics.items()
         }
+        if dev is not None:
+            # shard-labeled flush: which placement produced this window
+            self.last_devmetrics = self.devmetrics.flush(
+                dev, bucket=str(bucket),
+                shard=str(len(devs)), devices=_devices_label(devs),
+            )
         step.account(time.perf_counter() - t0)  # nondet-ok(same measurement)
         return host

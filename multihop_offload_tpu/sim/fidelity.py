@@ -50,6 +50,12 @@ from multihop_offload_tpu.obs import jaxhooks
 from multihop_offload_tpu.sim.policies import make_policy
 from multihop_offload_tpu.sim.runner import FleetSim
 from multihop_offload_tpu.sim.state import build_sim_params, spec_for
+from multihop_offload_tpu.sim.step import (
+    DM_DROP_ARR,
+    DM_DROP_CAP,
+    DM_DROP_FWD,
+    DM_QUEUE_DEPTH,
+)
 
 DEFAULT_UTILS = (0.1, 0.2, 0.3, 0.4, 0.5, 0.7, 0.85)
 
@@ -175,6 +181,55 @@ def composed_job_tau(inst, jobs, routes, emp_link, emp_srv) -> np.ndarray:
     job_link = np.where(inc > 0, d_ul + d_dl, 0.0).sum(axis=0)
     job_server = np.maximum(ul * emp_srv[np.asarray(routes.dst)], 1.0)
     return np.where(np.asarray(jobs.mask), job_link + job_server, 0.0)
+
+
+def analytic_mean_in_flight(inst, outcome) -> float:
+    """Expected total packets in system, Sum rho/(1-rho) over loaded M/M/1
+    queues (links + servers) — the Little's-law counterpart of the
+    devmetrics per-slot queue-depth histogram's mean."""
+    lam = np.asarray(outcome.delays.link_lambda, np.float64)
+    mu = np.asarray(outcome.delays.link_mu, np.float64)
+    ok_l = np.asarray(inst.link_mask) & (lam > 0) & (mu > lam)
+    l_links = float((lam[ok_l] / (mu[ok_l] - lam[ok_l])).sum()) \
+        if ok_l.any() else 0.0
+    load = np.asarray(outcome.delays.server_load, np.float64)
+    bw = np.asarray(inst.proc_bws, np.float64)
+    ok_s = (load > 0) & (bw > load)
+    l_srv = float((load[ok_s] / (bw[ok_s] - load[ok_s])).sum()) \
+        if ok_s.any() else 0.0
+    return l_links + l_srv
+
+
+def _devmetrics_row(flushed, outcomes, cases, fleet: int, slots: int):
+    """Per-utilization device-metrics block: the per-slot queue-depth
+    histogram vs the analytic expected in-flight, plus drop reasons the
+    terminal `SimState.dropped` cannot attribute."""
+    if not flushed:
+        return None
+    h = flushed.get(DM_QUEUE_DEPTH)
+    row = {
+        "drops": {
+            "no_route_forward": int(flushed.get(DM_DROP_FWD, 0)),
+            "no_route_arrival": int(flushed.get(DM_DROP_ARR, 0)),
+            "capacity": int(flushed.get(DM_DROP_CAP, 0)),
+        },
+    }
+    if h and h["count"]:
+        # the histogram observes every live queue every slot, so its sum
+        # over one segment is (total in-flight) integrated over slot-lanes
+        emp = h["sum"] / (fleet * slots)
+        ana = float(np.mean([
+            analytic_mean_in_flight(inst, out)
+            for (inst, _), out in zip(cases, outcomes)
+        ]))
+        row["queue_depth"] = {
+            "mean_in_flight_emp": float(emp),
+            "mean_in_flight_analytic": ana,
+            "rel_err": float(abs(emp - ana) / ana) if ana > 0 else None,
+            "max_depth": h["max"],
+            "counts": h["counts"],
+        }
+    return row
 
 
 def _end_to_end(inst, jobs, outcome, state, spec, dt):
@@ -303,6 +358,10 @@ def fidelity_sweep(
             "link": pool(link_errs),
             "server": pool(srv_errs),
             "end_to_end": pool(e2e_errs),
+            "devmetrics": _devmetrics_row(
+                sim.last_devmetrics, outcomes, scaled, fleet,
+                rounds * slots_per_round,
+            ),
             **total,
         })
         if first:

@@ -28,7 +28,7 @@ zero-unexpected-retrace gate in `sim.fidelity`).
 from __future__ import annotations
 
 import time
-from typing import Callable
+from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
@@ -49,7 +49,7 @@ from multihop_offload_tpu.sim.state import (
     init_state,
     liveness_masks,
 )
-from multihop_offload_tpu.sim.step import sim_slot_step
+from multihop_offload_tpu.sim.step import sim_devmetrics, sim_slot_step
 
 
 @struct.dataclass
@@ -60,6 +60,7 @@ class SimRun:
     routes: SimRoutes        # last policy decision in force
     est_rates: jnp.ndarray   # (R, J) per-round empirical rate estimates
     sched: jnp.ndarray | None  # (R, K, L) bool schedule trace, if collected
+    dev: Any = ()            # devmetrics accumulators for THIS segment
 
 
 def simulate(
@@ -74,14 +75,19 @@ def simulate(
     rounds: int,
     slots_per_round: int,
     collect_schedule: bool = False,
+    dm=None,
 ) -> SimRun:
-    """Run `rounds * slots_per_round` slots on one instance (pure, jittable)."""
+    """Run `rounds * slots_per_round` slots on one instance (pure, jittable).
+
+    With a `sim_devmetrics` declaration `dm`, the per-slot accumulators
+    ride the scan carries and come back as `SimRun.dev` — one window per
+    segment, starting from zeros."""
     j = spec.num_jobs
     n = spec.num_nodes
     fdt = state.delay_sum.dtype
 
     def round_body(carry, xs):
-        st, prev_gen, _ = carry
+        st, dev, prev_gen, _ = carry
         kr, is_first = xs
         k_dec, k_slots = jax.random.split(kr)
         node_up, link_up = liveness_masks(inst, params, st.t)
@@ -94,14 +100,22 @@ def simulate(
         jobs_est = jobs.replace(rate=est.astype(jobs.rate.dtype))
         routes = policy_fn(inst, jobs_est, node_up, link_up, k_dec)
 
-        def slot_body(s, kk):
-            s2, sched = sim_slot_step(inst, spec, params, routes, jobs, s, kk)
-            return s2, (sched if collect_schedule else None)
+        def slot_body(c, kk):
+            s, d = c
+            if dm is None:
+                s2, sched = sim_slot_step(
+                    inst, spec, params, routes, jobs, s, kk
+                )
+            else:
+                s2, sched, d = sim_slot_step(
+                    inst, spec, params, routes, jobs, s, kk, dm=dm, dev=d
+                )
+            return (s2, d), (sched if collect_schedule else None)
 
-        st2, scheds = jax.lax.scan(
-            slot_body, st, jax.random.split(k_slots, slots_per_round)
+        (st2, dev2), scheds = jax.lax.scan(
+            slot_body, (st, dev), jax.random.split(k_slots, slots_per_round)
         )
-        return (st2, st.generated, routes), (est, scheds)
+        return (st2, dev2, st.generated, routes), (est, scheds)
 
     from multihop_offload_tpu.layouts import NEXT_HOP_DTYPE
 
@@ -116,10 +130,12 @@ def simulate(
         jax.random.split(key, rounds),
         jnp.arange(rounds, dtype=jnp.int32) == 0,
     )
-    (st_f, _, routes_f), (ests, scheds) = jax.lax.scan(
-        round_body, (state, state.generated, routes0), xs
+    dev0 = dm.init() if dm is not None else ()
+    (st_f, dev_f, _, routes_f), (ests, scheds) = jax.lax.scan(
+        round_body, (state, dev0, state.generated, routes0), xs
     )
-    return SimRun(state=st_f, routes=routes_f, est_rates=ests, sched=scheds)
+    return SimRun(state=st_f, routes=routes_f, est_rates=ests, sched=scheds,
+                  dev=dev_f)
 
 
 class FleetSim:
@@ -140,18 +156,22 @@ class FleetSim:
         slots_per_round: int,
         collect_schedule: bool = False,
         dtype=jnp.float32,  # fp32-island(sim accumulators; precision only narrows the policy APSP)
+        devmetrics: bool = True,
     ):
         self.spec = spec
         self.rounds = rounds
         self.slots_per_round = slots_per_round
         self.collect_schedule = collect_schedule
         self.dtype = dtype
+        # declared before the first trace — a compile-time constant
+        self.devmetrics = sim_devmetrics(spec) if devmetrics else None
+        self.last_devmetrics: dict | None = None
         with span("sim/build", rounds=rounds, slots=slots_per_round):
             def one(inst, jobs, params, state, init_rates, key):
                 return simulate(
                     inst, jobs, spec, params, policy_fn, state,
                     init_rates, key, rounds, slots_per_round,
-                    collect_schedule,
+                    collect_schedule, dm=self.devmetrics,
                 )
 
             # registers with the prof layer on the first segment (AOT
@@ -212,6 +232,11 @@ class FleetSim:
         reg.gauge(
             "mho_sim_in_flight", "packets queued at segment end"
         ).set(int(jnp.sum(out.state.count[..., :-1])))
+        if self.devmetrics is not None:
+            # rides the sync boundary the span above already paid for;
+            # flush merges the fleet's vmap lanes into one window (and
+            # fetches the accumulators in one packed transfer)
+            self.last_devmetrics = self.devmetrics.flush(out.dev, reg=reg)
         if request_ids:
             st = jax.tree_util.tree_map(np.asarray, out.state)
             obs_trace.hop(

@@ -45,6 +45,7 @@ import jax.numpy as jnp
 
 from multihop_offload_tpu.env.scheduling import local_greedy_mwis
 from multihop_offload_tpu.graphs.instance import Instance, JobSet
+from multihop_offload_tpu.obs.devmetrics import DevMetrics, pow2_buckets
 from multihop_offload_tpu.sim.state import (
     SimParams,
     SimRoutes,
@@ -52,6 +53,37 @@ from multihop_offload_tpu.sim.state import (
     SimState,
     liveness_masks,
 )
+
+# Devmetric keys (declaration labels are part of the key, see
+# `obs.devmetrics._default_key`).  The three drop reasons partition
+# `SimState.dropped` exactly: per packet, `drop_l` / `drop_a` /
+# `put & ~space_ok` are mutually exclusive, so the summed per-reason
+# counters reproduce the state's OR-accumulated total bit for bit.
+DM_GENERATED = "mho_dev_sim_packets_generated_total"
+DM_DELIVERED = "mho_dev_sim_packets_delivered_total"
+DM_DROP_FWD = "mho_dev_sim_dropped_total{reason=no_route_forward}"
+DM_DROP_ARR = "mho_dev_sim_dropped_total{reason=no_route_arrival}"
+DM_DROP_CAP = "mho_dev_sim_dropped_total{reason=capacity}"
+DM_FWD_LINK = "mho_dev_sim_forwarded_total{target=link}"
+DM_FWD_SERVER = "mho_dev_sim_forwarded_total{target=server}"
+DM_QUEUE_DEPTH = "mho_dev_sim_queue_depth"
+
+
+def sim_devmetrics(spec: SimSpec) -> DevMetrics:
+    """Declare the sim hot loop's device metrics (frozen, trace-safe)."""
+    dm = DevMetrics()
+    dm.counter(DM_GENERATED, "packets born, counted in-program per slot")
+    dm.counter(DM_DELIVERED, "packets delivered (server drain + downlink at destination)")
+    for reason in ("no_route_forward", "no_route_arrival", "capacity"):
+        dm.counter("mho_dev_sim_dropped_total",
+                   "packets dropped, by reason", reason=reason)
+    for target in ("link", "server"):
+        dm.counter("mho_dev_sim_forwarded_total",
+                   "completed link packets re-enqueued, by next-hop target",
+                   target=target)
+    dm.histogram(DM_QUEUE_DEPTH, pow2_buckets(spec.cap),
+                 "per-slot occupancy of every live queue (links + servers)")
+    return dm.freeze()
 
 
 def sim_slot_step(
@@ -62,8 +94,15 @@ def sim_slot_step(
     jobs: JobSet,
     state: SimState,
     key: jax.Array,
+    dm: DevMetrics | None = None,
+    dev: dict | None = None,
 ):
-    """Advance one slot; returns (state', scheduled (L,) bool)."""
+    """Advance one slot; returns (state', scheduled (L,) bool).
+
+    With `dm`/`dev` (a `sim_devmetrics` declaration and its accumulator
+    pytree) the return value grows a third element, the updated
+    accumulators — pure scatter-adds on fixed shapes, no host traffic.
+    """
     num_links, n, j = spec.num_links, spec.num_nodes, spec.num_jobs
     c, q = spec.cap, spec.num_queues
     i32 = jnp.int32
@@ -222,4 +261,17 @@ def sim_slot_step(
         q_arrived=q_arrived, sched_slots=sched_slots,
         t=t + 1,
     )
-    return new_state, sched
+    if dm is None:
+        return new_state, sched
+    # slot-start depths: every live queue (scratch row excluded) before
+    # any service/arrival this slot touches it
+    dev = dm.observe(dev, DM_QUEUE_DEPTH, state.count[:q])
+    dev = dm.inc(dev, DM_GENERATED, gen)
+    dev = dm.inc(dev, DM_DELIVERED, nserve)
+    dev = dm.inc(dev, DM_DELIVERED, deliver_now)
+    dev = dm.inc(dev, DM_DROP_FWD, drop_l)
+    dev = dm.inc(dev, DM_DROP_ARR, drop_a)
+    dev = dm.inc(dev, DM_DROP_CAP, put & ~space_ok)
+    dev = dm.inc(dev, DM_FWD_LINK, put_l & ~to_server)
+    dev = dm.inc(dev, DM_FWD_SERVER, put_l & to_server)
+    return new_state, sched, dev

@@ -227,6 +227,18 @@ class _Harness:
         fp_fn, self.fp_path = resolve_fixed_point(self.cfg.fp_impl, self.data.pad.l)
         lay = self.layout
 
+        from multihop_offload_tpu.agent.train_step import (
+            DM_EPISODES, DM_GRAD_NORM, DM_LOSS_CRITIC_SQ, DM_LOSS_CRITIC_SUM,
+            DM_LOSS_MSE_SUM, episode_grad_norms, train_devmetrics,
+        )
+
+        # declared once, before the first trace: the in-program loss-moment
+        # and grad-norm accumulators the single-device step returns as its
+        # fifth output (the shard_map dp variants stay host-observed —
+        # parallel/ owns their collective budget)
+        dm = self.devmetrics = train_devmetrics()
+        self.last_devmetrics: dict | None = None
+
         def gnn_train_step(variables, mem, inst, jobsets, keys, explore):
             """vmapped forward_backward + in-program gradient memorization."""
 
@@ -251,7 +263,16 @@ class _Harness:
             mem, _ = jax.lax.scan(
                 remember, mem, jnp.arange(keys.shape[0], dtype=jnp.int32)
             )
-            return mem, outs.delays.job_total, outs.loss_critic, outs.loss_mse
+            dev = dm.init()
+            dev = dm.observe(dev, DM_GRAD_NORM,
+                             episode_grad_norms(outs.grads["params"]))
+            dev = dm.inc(dev, DM_LOSS_CRITIC_SUM, outs.loss_critic)
+            dev = dm.inc(dev, DM_LOSS_CRITIC_SQ,
+                         jnp.square(outs.loss_critic.astype(jnp.float32)))
+            dev = dm.inc(dev, DM_LOSS_MSE_SUM, outs.loss_mse)
+            dev = dm.inc(dev, DM_EPISODES, keys.shape[0])
+            return (mem, outs.delays.job_total, outs.loss_critic,
+                    outs.loss_mse, dev)
 
         compat_diag = self.cfg.compat_diagonal_bug
 
@@ -660,7 +681,8 @@ class Trainer(_Harness):
                         )
                     else:
                         td0 = time.perf_counter()  # nondet-ok(device-time accounting is a measurement)
-                        self.memory, gnn_totals, loss_c, loss_m = self._gnn_train_step(
+                        (self.memory, gnn_totals, loss_c, loss_m,
+                         dev_m) = self._gnn_train_step(
                             self.variables, self.memory, inst, jobsets,
                             self.next_keys(cfg.num_instances),
                             jnp.asarray(explore, cfg.jnp_dtype),
@@ -679,6 +701,9 @@ class Trainer(_Harness):
                         self._gnn_train_step.account(
                             time.perf_counter() - td0)  # nondet-ok(same measurement)
                         self._eval_methods.account(0.0)
+                        # step window's device accumulators, fetched at the
+                        # sync the block above already paid for
+                        self.last_devmetrics = self.devmetrics.flush(dev_m)
                 # runtime approximates METHOD compute only, net of the
                 # overlapped successor build — the reference's timer likewise
                 # excludes file prep (`AdHoc_test.py:126`).  With host and

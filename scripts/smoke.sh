@@ -6,13 +6,17 @@
 # Chains (each must pass; total budget a few minutes on a CPU host):
 #   1. bash scripts/lint.sh          — ruff (or the engine's pyflakes set)
 #      plus the repo's JAX-aware rules (JX001-JX007, MP001, SL001,
-#      OB001, OB002);
+#      OB001-OB003);
 #   2. mho-lint --json               — the static-analysis engine alone,
 #      proving the JSON surface and the seeded-violation fixture dir
 #      (every rule must fire there — a rule that can't detect its target
 #      pattern is a dead gate);
 #   3. mho-sim --smoke               — tiny simulator fleet: exact packet
-#      conservation + a link-failure round;
+#      conservation + a link-failure round; runs with --obs_log and then
+#      proves the device-native telemetry end to end: the mho-obs report
+#      grows a "device metrics" section and the in-program devmetrics
+#      packet counters agree EXACTLY with the SimState terminal counters
+#      in the same snapshot;
 #   4. mho-sim --smoke --layout sparse — the same fleet on the padded-COO
 #      sparse instance layout (edge-list propagate, gathered delay math,
 #      int16 indices) — proves the layout knob end to end;
@@ -63,14 +67,39 @@ out = subprocess.run(
      "tests/fixtures/analysis_seeded"], capture_output=True, text=True)
 fired = {f["rule"] for f in json.loads(out.stdout)["findings"]}
 need = {"JX001", "JX002", "JX003", "JX004", "JX005", "JX006",
-        "JX007", "MP001", "SL001", "OB001", "OB002"}
+        "JX007", "MP001", "SL001", "OB001", "OB002", "OB003"}
 missing = sorted(need - fired)
 assert not missing, f"rules silent on their seeded violations: {missing}"
 print(f"mho-lint: all {len(need)} repo rules fire on the seeded fixtures")
 EOF
 
-echo "== [3/9] mho-sim --smoke =="
-python -m multihop_offload_tpu.cli.sim --smoke
+echo "== [3/9] mho-sim --smoke (+ device metrics in the run report) =="
+SIM_LOG="$(mktemp -d)/run.jsonl"
+python -m multihop_offload_tpu.cli.sim --smoke --obs_log "$SIM_LOG"
+python - "$SIM_LOG" <<'EOF'
+import json, subprocess, sys
+log = sys.argv[1]
+report = subprocess.run(
+    [sys.executable, "-m", "multihop_offload_tpu.cli.obs", log],
+    capture_output=True, text=True, check=True).stdout
+assert "device metrics (in-program)" in report, \
+    "mho-obs report is missing the device-metrics section"
+run = json.loads(subprocess.run(
+    [sys.executable, "-m", "multihop_offload_tpu.cli.obs", log, "--json"],
+    capture_output=True, text=True, check=True).stdout)
+m = run["metrics"]
+def total(name):
+    return int(sum(float(v) for v in m[name]["series"].values()))
+# device-side accumulators vs the SimState terminal counters the host
+# registers at each segment end — same packets, must agree bit for bit
+host = {k: total(f"mho_sim_packets_{k}_total")
+        for k in ("generated", "delivered", "dropped")}
+dev = {"generated": total("mho_dev_sim_packets_generated_total"),
+       "delivered": total("mho_dev_sim_packets_delivered_total"),
+       "dropped": total("mho_dev_sim_dropped_total")}
+assert host == dev, f"devmetrics diverge from SimState: host={host} dev={dev}"
+print(f"devmetrics == SimState: {host} (exact), report section present")
+EOF
 
 echo "== [4/9] mho-sim --smoke --layout sparse =="
 python -m multihop_offload_tpu.cli.sim --smoke --layout sparse

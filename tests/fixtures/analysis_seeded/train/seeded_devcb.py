@@ -1,0 +1,34 @@
+"""Seeded violation: OB003 (host callback inside jit-reachable code).
+
+Lives under train/ (NOT obs/ — the obs layer owns deliberate host
+bridges and is exempt), so the jit-reachable `jax.debug.print` below
+must fire, while the host-only helper and the waived site must not.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import io_callback
+
+
+@jax.jit
+def traced_debug(x):
+    jax.debug.print("x = {}", x)  # OB003: device stalls on the host hop
+    return x * 2
+
+
+@jax.jit
+def traced_io(x):
+    io_callback(print, None, x)  # OB003: same, io_callback spelling
+    return x + 1
+
+
+@jax.jit
+def waived_site(x):
+    jax.debug.print("x = {}", x)  # devcb-ok(test fixture waiver)
+    return x
+
+
+def host_only_logger(x):
+    # NOT jit-reachable: host callbacks are fine outside compiled programs
+    jax.debug.print("host {}", jnp.sum(x))
+    return x

@@ -21,7 +21,7 @@ from multihop_offload_tpu.analysis.cli import main as lint_main
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SEEDED = os.path.join(REPO, "tests", "fixtures", "analysis_seeded")
 ALL_REPO_RULES = {"JX001", "JX002", "JX003", "JX004", "JX005", "JX006",
-                  "JX007", "MP001", "SL001", "OB001", "OB002"}
+                  "JX007", "MP001", "SL001", "OB001", "OB002", "OB003"}
 
 
 def run_on(tmp_path, files, select=None, baseline=None):
@@ -158,6 +158,47 @@ def test_ob002_exempts_obs_dir(tmp_path):
     rep = run_on(tmp_path, {
         "obs/prof.py": "def f(c):\n    return c.cost_analysis()\n"})
     assert "OB002" not in rules_hit(rep)
+
+
+def test_ob003_tp_waived_and_reachability_guard(tmp_path):
+    rep = run_on(tmp_path, {"train/m.py": """\
+        import jax
+        from jax.experimental import io_callback
+
+        @jax.jit
+        def tp(x):
+            jax.debug.print("x = {}", x)
+            return x
+
+        @jax.jit
+        def tp_io(x):
+            io_callback(print, None, x)
+            return x
+
+        @jax.jit
+        def waived(x):
+            jax.debug.print("x = {}", x)  # devcb-ok(test)
+            return x
+
+        def host_only(x):
+            jax.debug.print("host {}", x)
+            return x
+    """})
+    ob = [f for f in rep.findings if f.rule == "OB003"]
+    assert {f.line for f in ob} == {6, 11}  # host-only fn untouched
+    assert len([f for f in rep.waived if f.rule == "OB003"]) == 1
+
+
+def test_ob003_exempts_obs_dir(tmp_path):
+    rep = run_on(tmp_path, {"obs/bridge.py": """\
+        import jax
+
+        @jax.jit
+        def deliberate_bridge(x):
+            jax.debug.print("obs owns this hop {}", x)
+            return x
+    """})
+    assert "OB003" not in rules_hit(rep)
 
 
 def test_jx001_tp_waived_and_shadow_guard(tmp_path):

@@ -1,0 +1,193 @@
+"""obs/devmetrics: device-side accumulators through jit/vmap/scan/shards.
+
+The contract under test: declared-once metrics updated with pure jnp ops
+inside compiled programs, merged across leading axes (vmap lanes, shard
+copies) at flush, landing in the host registry with EXACT counts — plus
+the registry's label-cardinality cap that keeps the flush sink bounded.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from multihop_offload_tpu.obs import jaxhooks
+from multihop_offload_tpu.obs.devmetrics import DevMetrics, pow2_buckets
+from multihop_offload_tpu.obs.registry import DROPPED_LABELSETS, MetricRegistry
+
+BOUNDS = (0.0, 1.0, 2.0, 4.0, 8.0)
+
+
+def _dm():
+    dm = DevMetrics()
+    c = dm.counter("mho_dev_t_events_total", "events seen")
+    g = dm.gauge("mho_dev_t_level", "last level")
+    h = dm.histogram("mho_dev_t_depth", BOUNDS, "depth")
+    return dm.freeze(), c, g, h
+
+
+def _hand_hist(values, weights=None):
+    """Prometheus `le` bucketing (+Inf tail) in plain numpy."""
+    v = np.ravel(np.asarray(values, np.float64))
+    w = (np.ones(v.shape, np.int64) if weights is None
+         else np.ravel(np.asarray(weights, np.int64)))
+    idx = np.searchsorted(np.asarray(BOUNDS, np.float64), v, side="left")
+    counts = np.zeros(len(BOUNDS) + 1, np.int64)
+    np.add.at(counts, idx, w)
+    live = v[w > 0]
+    return {
+        "counts": counts.tolist(),
+        "count": int(w.sum()),
+        "sum": float(np.sum(v * w)),
+        "min": float(live.min()) if live.size else None,
+        "max": float(live.max()) if live.size else None,
+    }
+
+
+def test_pow2_buckets_ladder():
+    assert pow2_buckets(64) == (0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0)
+    assert pow2_buckets(6) == (0.0, 1.0, 2.0, 4.0, 6.0)
+
+
+def test_roundtrip_through_jit_vmap_scan():
+    dm, C, G, H = _dm()
+    lanes, steps, width = 3, 7, 4
+    xs_np = (np.arange(lanes * steps * width) % 9).astype(np.float32)
+    xs_np = xs_np.reshape(lanes, steps, width)
+
+    def body(dev, x):
+        dev = dm.inc(dev, C, x > 0)
+        dev = dm.set(dev, G, jnp.sum(x))
+        dev = dm.observe(dev, H, x)
+        return dev, ()
+
+    @jax.jit
+    def run(xs):
+        def lane(x_lane):
+            dev, _ = jax.lax.scan(body, dm.init(), x_lane)
+            return dev
+
+        return jax.vmap(lane)(xs)
+
+    flushed = dm.flush(run(jnp.asarray(xs_np)), reg=MetricRegistry())
+    want = _hand_hist(xs_np)
+    assert int(flushed[C]) == int((xs_np > 0).sum())
+    assert flushed[H]["counts"] == want["counts"]
+    assert flushed[H]["count"] == want["count"]
+    assert flushed[H]["sum"] == want["sum"]  # small ints: exact in f32
+    assert (flushed[H]["min"], flushed[H]["max"]) == (want["min"], want["max"])
+    # gauge keeps the last written value per lane; flush averages lanes
+    assert flushed[G] == pytest.approx(
+        float(np.mean(xs_np[:, -1, :].sum(axis=1))))
+
+
+def test_flush_merges_leading_axes_like_hand_math():
+    dm, C, G, H = _dm()
+    d1 = dm.init()
+    d1 = dm.observe(d1, H, jnp.asarray([0.0, 0.5, 3.0]),
+                    weights=jnp.asarray([1, 0, 2]))
+    d1 = dm.inc(d1, C, 5)
+    d1 = dm.set(d1, G, 2.0)
+    d2 = dm.init()
+    d2 = dm.observe(d2, H, jnp.asarray([9.0, 1.0]))
+    d2 = dm.inc(d2, C, jnp.asarray([True, False, True]))
+    d2 = dm.set(d2, G, 4.0)
+    stacked = jax.tree_util.tree_map(lambda a, b: jnp.stack([a, b]), d1, d2)
+
+    reg = MetricRegistry()
+    out = dm.flush(stacked, reg=reg, shard="x")
+    assert int(out[C]) == 7
+    assert out[G] == pytest.approx(3.0)  # replica gauges average
+    # weight-0 entries touch neither counts nor sum/min/max
+    assert out[H]["counts"] == [1, 1, 0, 2, 0, 1]
+    assert out[H] == {"counts": [1, 1, 0, 2, 0, 1], "count": 5,
+                      "sum": 16.0, "min": 0.0, "max": 9.0}
+    # the registry saw the same series, under the flush-site labels
+    assert reg.counter("mho_dev_t_events_total").value(shard="x") == 7.0
+    snap = reg.snapshot()["mho_dev_t_depth"]["series"]['{shard="x"}']
+    assert (snap["count"], snap["sum"]) == (5, 16.0)
+
+    # a second window flush ACCUMULATES into the same registry series
+    dm.flush(stacked, reg=reg, shard="x")
+    assert reg.counter("mho_dev_t_events_total").value(shard="x") == 14.0
+
+
+def test_cross_shard_reduction_on_virtual_mesh():
+    """Under a sharded program the accumulators reduce across the mesh
+    inside the compiled program (GSPMD allreduce), landing replicated."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    devs = jax.devices()
+    assert len(devs) >= 8, "conftest forces an 8-device host platform"
+    mesh = Mesh(np.asarray(devs[:8]), ("d",))
+    dm, C, G, H = _dm()
+
+    @jax.jit
+    def step(x):
+        dev = dm.init()
+        dev = dm.inc(dev, C, x > 0)
+        dev = dm.set(dev, G, jnp.mean(x))
+        dev = dm.observe(dev, H, x)
+        return dev
+
+    x_np = (np.arange(64) % 11).astype(np.float32)
+    xs = jax.device_put(jnp.asarray(x_np), NamedSharding(mesh, PartitionSpec("d")))
+    dev = step(xs)
+    assert dev["c"][C].sharding.is_fully_replicated
+
+    flushed = dm.flush(dev, reg=MetricRegistry())
+    want = _hand_hist(x_np)
+    assert int(flushed[C]) == int((x_np > 0).sum())
+    assert flushed[H]["counts"] == want["counts"]
+    assert flushed[H]["sum"] == want["sum"]
+
+
+def test_steady_state_updates_and_flushes_do_not_retrace():
+    dm, C, G, H = _dm()
+
+    @jax.jit
+    def step(x):
+        dev = dm.init()
+        dev = dm.inc(dev, C, x > 0)
+        dev = dm.observe(dev, H, x)
+        return dev
+
+    jaxhooks.install()
+    x = jnp.arange(16.0)
+    dm.flush(step(x), reg=MetricRegistry())  # warm: program + bulk packer
+    before = jaxhooks.unexpected_retraces()
+    jaxhooks.mark_steady()
+    try:
+        for _ in range(3):
+            dm.flush(step(x), reg=MetricRegistry())
+        assert jaxhooks.unexpected_retraces() == before
+    finally:
+        jaxhooks.clear_steady()
+
+
+def test_declaration_is_frozen_after_init():
+    dm = DevMetrics()
+    dm.counter("mho_dev_t_a_total")
+    dm.init()
+    with pytest.raises(RuntimeError):
+        dm.counter("mho_dev_t_b_total")
+    with pytest.raises(ValueError):
+        DevMetrics().histogram("mho_dev_t_h", ())
+
+
+def test_registry_label_cardinality_cap(monkeypatch):
+    monkeypatch.setenv("MHO_REGISTRY_MAX_LABELSETS", "3")
+    reg = MetricRegistry()
+    c = reg.counter("capped_total", "cap drill")
+    with pytest.warns(RuntimeWarning, match="label-set cap"):
+        for i in range(5):
+            c.inc(1, worker=str(i))
+    assert c.value(worker="0") == 1.0
+    assert c.value(worker="2") == 1.0
+    assert c.value(worker="4") == 0.0  # beyond the cap: dropped
+    assert c.total() == 3.0            # only the admitted series count
+    assert reg.counter(DROPPED_LABELSETS).value(metric="capped_total") == 2.0
+    # existing series keep updating — only NEW label sets are refused
+    c.inc(1, worker="1")
+    assert c.value(worker="1") == 2.0
