@@ -178,11 +178,15 @@ def build_bench_batch():
 
     propagate = None
     if lay.sparse:
+        # BENCH_CHEB_IMPL=pallas swaps the XLA gather+segment-sum for the
+        # fused Pallas tile (ops.chebconv) — the matrix runner's A/B lever
         from multihop_offload_tpu.layouts import make_sparse_propagate
+        from multihop_offload_tpu.ops.chebconv import resolve_chebconv
 
-        propagate = make_sparse_propagate(
-            pol.accum_dtype if pol.mixed else None
-        )
+        factory, _ = resolve_chebconv(os.environ.get("BENCH_CHEB_IMPL",
+                                                     "auto"))
+        make_prop = factory if factory is not None else make_sparse_propagate
+        propagate = make_prop(pol.accum_dtype if pol.mixed else None)
     model = ChebNet(
         param_dtype=pol.param_dtype,
         compute_dtype=pol.compute_dtype if pol.mixed else None,
@@ -257,13 +261,27 @@ def measure():
     precision = _bench_precision()
     apsp_fn = precision.wrap_apsp(apsp_fn)
     layout = _bench_layout()
+    # sparse layout: the same BENCH_APSP_IMPL knob resolves the COO-fed
+    # regime (no dense scatter; bit-identical) — no precision wrap: the min
+    # is exact and the delays already carry the model's compute dtype
+    apsp_edges_fn = cheb_path = coo_apsp_path = None
+    if layout.sparse:
+        from multihop_offload_tpu.ops.chebconv import resolve_chebconv
+        from multihop_offload_tpu.ops.minplus import resolve_coo_apsp
+
+        apsp_edges_fn, coo_apsp_path = resolve_coo_apsp(apsp_impl, pad.n)
+        if apsp_edges_fn is not None:
+            apsp_path = coo_apsp_path
+        _, cheb_path = resolve_chebconv(
+            os.environ.get("BENCH_CHEB_IMPL", "auto"))
 
     @jax.jit
     def step(variables, insts, jobs, keys):
         outs = jax.vmap(
             lambda i, jb, k: forward_backward(model, variables, i, jb, k,
                                               explore=0.0, apsp_fn=apsp_fn,
-                                              fp_fn=fp_fn, layout=layout)
+                                              fp_fn=fp_fn, layout=layout,
+                                              apsp_edges_fn=apsp_edges_fn)
         )(insts, jobs, keys)
         return outs.grads, outs.loss_critic, outs.delays.job_total
 
@@ -369,6 +387,8 @@ def measure():
         "platform": platform,
         "apsp_path": apsp_path,
         "fp_path": fp_path,
+        "cheb_path": cheb_path,
+        "coo_apsp_path": coo_apsp_path,
         "precision": precision.name,
         "layout": layout.name,
         "roofline": {

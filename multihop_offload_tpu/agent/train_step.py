@@ -316,6 +316,7 @@ def forward_backward(
     dropout_rng: jax.Array | None = None,
     compat_diagonal_bug: bool = False,
     layout=None,
+    apsp_edges_fn=None,
 ) -> TrainStepOutput:
     lay = resolve_layout(layout)
     if support is None:
@@ -347,15 +348,24 @@ def forward_backward(
         )
     else:
         unit_diag = lax.stop_gradient(jnp.diagonal(dmtx))
-    if lay.sparse:
-        w = weight_matrix_from_edges(
+    if lay.sparse and apsp_edges_fn is not None:
+        # COO-fed regime (`ops.minplus.resolve_coo_apsp`): the dense (N, N)
+        # weight matrix never materializes — the kernel rebuilds it from the
+        # link list in registers, bit-identical to the scatter+apsp chain
+        sp = apsp_edges_fn(
             inst.link_ends, inst.link_mask, link_delay, inst.num_pad_nodes
         )
     else:
-        w = weight_matrix_from_link_delays(
-            inst.adj, inst.link_index, link_delay
-        )
-    sp = apsp(w)
+        if lay.sparse:
+            w = weight_matrix_from_edges(
+                inst.link_ends, inst.link_mask, link_delay,
+                inst.num_pad_nodes
+            )
+        else:
+            w = weight_matrix_from_link_delays(
+                inst.adj, inst.link_index, link_delay
+            )
+        sp = apsp(w)
     # hop counts are topology-only and precomputed at Instance build time
     # (the reference recomputes Dijkstra hops per call, `:304-305`)
     dec = offload_decide(inst, jobs, sp, inst.hop, unit_diag, key, explore, prob)

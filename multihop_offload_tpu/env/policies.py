@@ -53,6 +53,7 @@ def evaluate_spmatrix_policy(
     apsp_fn=None,
     fp_fn=None,
     layout=None,
+    apsp_edges_fn=None,
 ) -> PolicyOutcome:
     """Offload + route + run given per-link unit delays and a node diagonal.
 
@@ -61,7 +62,9 @@ def evaluate_spmatrix_policy(
     `gnn_offloading_agent.py:278-291`): build the one-hop weight matrix, run
     min-plus APSP + hop counts, take the greedy decision, trace routes, and
     score empirically.  `apsp_fn` overrides the APSP kernel (e.g. the
-    mesh-sharded ring variant from `parallel.ring` for large graphs).
+    mesh-sharded ring variant from `parallel.ring` for large graphs);
+    `apsp_edges_fn` (sparse layout only) replaces the whole scatter+APSP
+    chain with a COO-fed kernel (`ops.minplus.resolve_coo_apsp`).
 
     Under `layout=sparse` the weight matrix is scatter-built from the link
     list, the next-hop table comes from a directed-edge segment-min, and the
@@ -72,15 +75,23 @@ def evaluate_spmatrix_policy(
     """
     lay = resolve_layout(layout)
     apsp = apsp_fn or (apsp_minplus_blocked if lay.sparse else apsp_minplus)
-    if lay.sparse:
-        w = weight_matrix_from_edges(
+    if lay.sparse and apsp_edges_fn is not None:
+        # COO-fed regime (`ops.minplus.resolve_coo_apsp`): skip the dense
+        # scatter entirely — bit-identical to the chain below
+        sp = apsp_edges_fn(
             inst.link_ends, inst.link_mask, link_delays, inst.num_pad_nodes
         )
     else:
-        w = weight_matrix_from_link_delays(
-            inst.adj, inst.link_index, link_delays
-        )
-    sp = apsp(w)
+        if lay.sparse:
+            w = weight_matrix_from_edges(
+                inst.link_ends, inst.link_mask, link_delays,
+                inst.num_pad_nodes
+            )
+        else:
+            w = weight_matrix_from_link_delays(
+                inst.adj, inst.link_index, link_delays
+            )
+        sp = apsp(w)
     # hop counts are topology-only and precomputed at Instance build time
     dec = offload_decide(inst, jobs, sp, inst.hop, unit_diag, key, explore, prob)
     if lay.sparse:

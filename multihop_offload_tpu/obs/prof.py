@@ -392,6 +392,28 @@ def prof_registry() -> ProgramRegistry:
     return _DEFAULT
 
 
+def register_kernel(name: str, *, flops: float, bytes_accessed: float,
+                    argument_bytes: Optional[float] = None,
+                    labels: Optional[Dict[str, str]] = None,
+                    registry: Optional[ProgramRegistry] = None) -> None:
+    """Register ANALYTIC cost facts for a hand-written (Pallas) kernel.
+
+    Mosaic kernels never pass through `extract_cost` (there is no XLA
+    cost analysis to read), so the kernels hand-count their flops/bytes
+    (`ops.chebconv.chebconv_cost_facts`, `ops.minplus.coo_apsp_cost_facts`)
+    and register here at trace time — from then on `account()` drives the
+    same `mho_program_mfu` / `mho_program_hbm_frac` gauges as every
+    extracted program.  Idempotent per (name, facts): re-registering on a
+    retrace just refreshes the record like any bucket recompile."""
+    reg = registry or prof_registry()
+    reg.register(name, compile_s=0.0, flops=float(flops),
+                 bytes_accessed=float(bytes_accessed),
+                 argument_bytes=(float(argument_bytes)
+                                 if argument_bytes is not None
+                                 else float(bytes_accessed)),
+                 temp_bytes=0.0, labels=labels)
+
+
 # ---- AOT wrap helper -------------------------------------------------------
 
 class ProfiledProgram:

@@ -1,9 +1,18 @@
 from multihop_offload_tpu.ops.minplus import (  # noqa: F401
+    apsp_minplus_coo,
     apsp_minplus_pallas,
+    coo_apsp_path,
     minplus_power_kernel_call,
     resolve_apsp,
+    resolve_coo_apsp,
 )
 from multihop_offload_tpu.ops.fixed_point import fixed_point_pallas  # noqa: F401
+from multihop_offload_tpu.ops.chebconv import (  # noqa: F401
+    chebconv_path,
+    chebconv_propagate_pallas,
+    make_fused_propagate,
+    resolve_chebconv,
+)
 from multihop_offload_tpu.ops.sparse import (  # noqa: F401
     COO,
     coo_matmul,

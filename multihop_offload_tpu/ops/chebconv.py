@@ -1,0 +1,237 @@
+"""Fused Pallas ChebConv propagate: gather -> segment-sum in one kernel.
+
+The sparse layout's Chebyshev recurrence (`layouts.sparse.make_sparse_propagate`)
+lowers to XLA as a gather of x rows followed by a serialized `segment_sum`
+scatter — the exact shape "Fast Training of Sparse GNNs on Dense Hardware"
+(PAPERS.md) identifies as leaving dense-hardware throughput on the table.
+This module fuses the two into one edge-tiled kernel:
+
+- the grid walks edge blocks; each block builds a (N, Eb) one-hot gather
+  matrix from the block's `cols` and pulls `x[cols]` out of VMEM with a
+  single MXU matmul (`one_hot(cols).T @ x` is exact — one-hot rows select,
+  they never mix values);
+- the segment-sum is a second matmul against the scatter one-hot with the
+  edge weights folded in (`where(node == rows, vals, 0) @ gathered`),
+  accumulated in the >= fp32 island dtype directly in the revisited output
+  block — registers/VMEM across the whole edge walk, ONE HBM write per
+  node tile when the grid retires;
+- block 0 seeds the accumulator with the diagonal term `diag[:, None] * x`.
+
+fp32 adds reassociate, so unlike the COO min-plus APSP (exact min) the fused
+tile is NOT bit-identical to `segment_sum`; tests pin values/grads to the
+layouts/ 4.5e-7 bar and decisions bit-identical.  The `custom_vjp` recomputes
+the backward through the exact `make_sparse_propagate` math, so the trained
+path keeps the step-form critic gradient (`agent.train_step`) unchanged.
+
+Honesty contract matches `minplus.pallas_apsp_path`: `chebconv_path`
+reports the executed implementation, off-TPU non-interpret delegates to the
+XLA reference, and `resolve_chebconv('auto')` stays on XLA until
+`benchmarks/bench_matrix.json` carries an on-chip `chebconv_perf` win —
+the same stop-at-measured-evidence rule as `fixed_point._AUTO_FP_MAX_L`.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from multihop_offload_tpu.ops.minplus import tpu_backend
+from multihop_offload_tpu.precision import island_dtype
+
+_LANE = 128      # f32 lane tile (last dim)
+_SUBLANE = 8     # f32 sublane tile (second-to-last dim)
+_EDGE_BLOCK = 512  # edges walked per grid step (VMEM one-hot: N x 512)
+
+# shapes whose analytic cost facts are already registered (per process) —
+# registration happens at trace time, once per distinct kernel shape
+_REGISTERED: set = set()
+
+
+def _xla_propagate(rows, cols, vals, diag, x, acc):
+    """The one true reference: `layouts.sparse.make_sparse_propagate` math,
+    inlined to avoid an ops<->layouts import cycle.  The VJP recompute must
+    pull back through exactly what the rest of the framework runs."""
+    contrib = (vals[:, None] * x[cols]).astype(acc)
+    agg = jax.ops.segment_sum(contrib, rows, num_segments=x.shape[0])
+    agg = agg + diag.astype(acc)[:, None] * x.astype(acc)
+    return agg.astype(x.dtype)
+
+
+def _chebconv_kernel(rows_ref, cols_ref, vals_ref, diag_ref, x_ref, o_ref):
+    x = x_ref[...]                       # (N, F) acc dtype
+    n = x.shape[0]
+
+    @pl.when(pl.program_id(0) == 0)
+    def _seed_diag():
+        o_ref[...] = diag_ref[...] * x   # (N, 1) * (N, F)
+
+    rows = rows_ref[...]                 # (1, Eb) int32
+    cols = cols_ref[...]
+    vals = vals_ref[...]                 # (1, Eb) acc dtype
+    node = jax.lax.broadcasted_iota(jnp.int32, (n, rows.shape[1]), 0)
+    gather = (node == cols).astype(x.dtype)          # one-hot per edge col
+    gathered = jax.lax.dot_general(                  # (Eb, F) == x[cols]
+        gather, x, (((0,), (0,)), ((), ())),
+        preferred_element_type=x.dtype)
+    scatter = jnp.where(node == rows, vals, 0).astype(x.dtype)
+    o_ref[...] += jax.lax.dot_general(               # fused segment-sum
+        scatter, gathered, (((1,), (0,)), ((), ())),
+        preferred_element_type=x.dtype)
+
+
+def chebconv_cost_facts(n: int, nnz: int, feat: int,
+                        dtype_bytes: int = 4) -> dict:
+    """Analytic cost facts for the fused tile (EXECUTED work — the one-hot
+    formulation runs two (N, Eb) x (Eb, F)-class matmuls per block, which is
+    what the MXU actually retires and what an honest MFU divides by)."""
+    flops = 4.0 * n * nnz * feat + 2.0 * n * feat   # 2 matmuls + diag seed
+    bytes_accessed = (
+        2 * nnz * 4                   # rows + cols (int32)
+        + nnz * dtype_bytes           # vals
+        + n * dtype_bytes             # diag
+        + 2 * n * feat * dtype_bytes  # x in + one out write per node tile
+    )
+    return {"flops": flops, "bytes_accessed": float(bytes_accessed),
+            "argument_bytes": float(bytes_accessed - n * feat * dtype_bytes)}
+
+
+def _register(n: int, nnz: int, feat: int, dtype_bytes: int) -> None:
+    key = (n, nnz, feat, dtype_bytes)
+    if key in _REGISTERED:
+        return
+    _REGISTERED.add(key)
+    from multihop_offload_tpu.obs.prof import register_kernel
+
+    register_kernel(
+        "ops/chebconv", **chebconv_cost_facts(n, nnz, feat, dtype_bytes),
+        labels={"kind": "pallas", "shape": f"n{n}_nnz{nnz}_f{feat}"})
+
+
+def _pad_to(v: int, m: int) -> int:
+    return max(m, math.ceil(v / m) * m)
+
+
+def _forward(rows, cols, vals, diag, x, acc_name, interpret, edge_block):
+    acc = jnp.dtype(acc_name)
+    if not interpret and not tpu_backend():
+        # honesty contract: off-TPU the Mosaic kernel cannot lower; run the
+        # reference (chebconv_path reports 'xla-fallback')
+        return _xla_propagate(rows, cols, vals, diag, x, acc)
+
+    n, f = x.shape
+    (e,) = rows.shape
+    n_pad = _pad_to(n, _SUBLANE)
+    f_pad = _pad_to(f, _LANE)
+    eb = min(edge_block, _pad_to(e, _LANE))
+    e_pad = _pad_to(e, eb)
+    _register(n_pad, e_pad, f_pad, acc.itemsize)
+
+    # pad edges with (row=0, col=0, val=0): inert — the scatter one-hot
+    # column is all zero, so the pad contributes exact +0.0 to row 0,
+    # matching the sparse layout's own nnz padding convention
+    rows_p = jnp.zeros((1, e_pad), jnp.int32).at[0, :e].set(rows)
+    cols_p = jnp.zeros((1, e_pad), jnp.int32).at[0, :e].set(cols)
+    vals_p = jnp.zeros((1, e_pad), acc).at[0, :e].set(vals.astype(acc))
+    diag_p = jnp.zeros((n_pad, 1), acc).at[:n, 0].set(diag.astype(acc))
+    x_p = jnp.zeros((n_pad, f_pad), acc).at[:n, :f].set(x.astype(acc))
+
+    out = pl.pallas_call(
+        _chebconv_kernel,
+        grid=(e_pad // eb,),
+        in_specs=[
+            pl.BlockSpec((1, eb), lambda i: (0, i)),      # rows
+            pl.BlockSpec((1, eb), lambda i: (0, i)),      # cols
+            pl.BlockSpec((1, eb), lambda i: (0, i)),      # vals
+            pl.BlockSpec((n_pad, 1), lambda i: (0, 0)),   # diag
+            pl.BlockSpec((n_pad, f_pad), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((n_pad, f_pad), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_pad, f_pad), acc),
+        interpret=interpret,
+    )(rows_p, cols_p, vals_p, diag_p, x_p)
+    return out[:n, :f].astype(x.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def chebconv_propagate_pallas(rows, cols, vals, diag, x,
+                              acc_name: str = "float32",
+                              interpret: bool = False,
+                              edge_block: int = _EDGE_BLOCK):
+    """Fused gather->segment-sum ChebConv propagate (custom_vjp primal).
+
+    Args are the flattened `SparseSupport` (`rows`/`cols`/`vals` the padded
+    COO, `diag` the (N,) diagonal) plus the (N, F) node features.  The
+    static tail (`acc_name`/`interpret`/`edge_block`) is nondiff; the
+    backward recomputes through `_xla_propagate`, so gradients are exactly
+    the reference chain's (step-form critic included) regardless of which
+    forward executed."""
+    return _forward(rows, cols, vals, diag, x, acc_name, interpret,
+                    edge_block)
+
+
+def _cheb_fwd(rows, cols, vals, diag, x, acc_name, interpret, edge_block):
+    out = chebconv_propagate_pallas(rows, cols, vals, diag, x, acc_name,
+                                    interpret, edge_block)
+    return out, (rows, cols, vals, diag, x)
+
+
+def _cheb_bwd(acc_name, interpret, edge_block, res, g):
+    rows, cols, vals, diag, x = res
+    _, vjp = jax.vjp(
+        functools.partial(_xla_propagate, acc=jnp.dtype(acc_name)),
+        rows, cols, vals, diag, x)
+    return vjp(g)  # float0 cotangents for the int rows/cols
+
+
+chebconv_propagate_pallas.defvjp(_cheb_fwd, _cheb_bwd)
+
+
+def make_fused_propagate(accum_dtype=None, *, interpret: bool = False,
+                         edge_block: int = _EDGE_BLOCK):
+    """Drop-in twin of `layouts.sparse.make_sparse_propagate` running the
+    fused Pallas tile: `propagate(support, x)` with the same accumulation
+    contract (>= fp32 island unless `accum_dtype` pins it)."""
+
+    def propagate(support, x):
+        e = support.edges
+        acc = jnp.dtype(accum_dtype or island_dtype(x.dtype))
+        return chebconv_propagate_pallas(
+            e.rows, e.cols, e.vals, support.diag, x, acc.name, interpret,
+            edge_block)
+
+    return propagate
+
+
+def chebconv_path(interpret: bool = False) -> str:
+    """Which implementation `chebconv_propagate_pallas` actually runs:
+    'pallas' | 'xla-fallback' — same honesty contract as
+    `minplus.pallas_apsp_path` (callers report the executed path)."""
+    if interpret:
+        return "pallas"
+    return "pallas" if tpu_backend() else "xla-fallback"
+
+
+def resolve_chebconv(impl: str, interpret: bool = False):
+    """Resolve the `cheb_impl` knob to a propagate factory.
+
+    Mirrors `minplus.resolve_apsp`: returns ``(make_propagate, path)`` where
+    ``make_propagate`` is None for the default XLA segment-sum (callers
+    treat None as `layouts.sparse.make_sparse_propagate`) and otherwise a
+    ``make_fused_propagate``-shaped factory.  'auto' resolves to XLA
+    everywhere until `benchmarks/bench_matrix.json` records an on-chip
+    `chebconv_perf` gate win — the fused tile has no measured in-step
+    evidence yet, and 'auto' stops at measured evidence (the
+    `_AUTO_FP_MAX_L` rule)."""
+    if impl not in ("xla", "pallas", "auto"):
+        raise ValueError(f"cheb_impl must be xla|pallas|auto, got '{impl}'")
+    if impl in ("xla", "auto"):
+        return None, "xla"
+
+    def factory(accum_dtype=None):
+        return make_fused_propagate(accum_dtype, interpret=interpret)
+
+    return factory, chebconv_path(interpret=interpret)

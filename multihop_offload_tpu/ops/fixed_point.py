@@ -82,16 +82,18 @@ def fixed_point_path(interpret: bool = False) -> str:
 # Measured crossover, round-5 evidence set: IN-STEP (the authoritative
 # signal — `benchmarks/fp_ab.json`, 200-rep idle-host legs) the kernel wins
 # 1.16x at the production padded L=256, and that is the LAST rung with an
-# in-step A/B.  The L=384/512 in-step rungs now exist as first-class
-# measurements (`scripts/fp_ab.py` BENCH_PAD_L legs, committed under
-# `benchmarks/fp_ab.json` "rungs"); as of this writing both are null —
-# awaiting an on-chip run — and the only 384/512 evidence remains the
-# isolated microbench ladder (`pallas_tpu.json` l384/l512: 0.94/1.13x)
-# sitting on the tunnel's ~4ms dispatch floor, where the 384 rung is an
-# outright loss.  'auto' therefore stops at the measured win (256) rather
-# than extrapolating the microbench trend; raise this only when the
-# fp_ab.json rung for the shape shows an in-step pallas_over_xla > 1.
-# `fp_impl=pallas` remains the explicit override for larger pads.
+# in-step A/B.  The L=384/512 in-step rungs are now campaign legs of the
+# matrix runner (`mho-bench --matrix`, gates `fp_rung_384`/`fp_rung_512`
+# in `benchmarks/bench_matrix.json` — one chip session runs the whole
+# knob cross-product); as of this writing both gates are null — awaiting
+# a chip run — and the only 384/512 evidence remains the isolated
+# microbench ladder (`pallas_tpu.json` l384/l512: 0.94/1.13x) sitting on
+# the tunnel's ~4ms dispatch floor, where the 384 rung is an outright
+# loss.  'auto' therefore stops at the measured win (256) rather than
+# extrapolating the microbench trend; raise this only when the
+# bench_matrix.json rung gate for the shape shows an in-step
+# pallas-over-xla > 1.  `fp_impl=pallas` remains the explicit override
+# for larger pads.
 _AUTO_FP_MAX_L = 256
 
 

@@ -1,6 +1,6 @@
 """Pallas TPU kernels for min-plus all-pairs shortest paths.
 
-Two regimes, replacing the reference's per-graph Dijkstra loop
+Three regimes, replacing the reference's per-graph Dijkstra loop
 (`util.py:101-110`, its hottest non-TF routine):
 
 * **Whole-matrix squaring** (padded N <= 256): the distance matrix lives in
@@ -23,7 +23,12 @@ Two regimes, replacing the reference's per-graph Dijkstra loop
   the squaring's O(N^3 log N), and each phase writes only its blocks
   in-place (`input_output_aliases`), so HBM traffic per pivot is O(N^2).
 
-A padded-with-inf border is inert under (min, +) for both paths.
+* **COO-fed squaring** (`apsp_minplus_coo`, padded N <= 256): the sparse
+  layout's regime — W is rebuilt in registers straight from the padded
+  link list (no dense (N, N) scatter in HBM) and handed to the same
+  chunked squaring, bit-identical to the scatter+XLA reference chain.
+
+A padded-with-inf border is inert under (min, +) for all paths.
 """
 
 from __future__ import annotations
@@ -43,16 +48,18 @@ _LANE = 128
 _ROW_CHUNK = 8  # f32 sublane count: rows extracted one sublane group at a time
 
 
-def _apsp_kernel(d_ref, o_ref, *, n: int, iters: int):
-    d = d_ref[0]
-    # Mosaic has no dynamic_slice on a value held in registers, so pivot rows
-    # are extracted with masked min-reduces (inert +inf elsewhere).  Doing
-    # that per pivot costs O(N^2) VPU work per row — as much as the update
-    # itself (round-3 verdict: the kernel lost to XLA below N=512 mostly on
-    # this).  Min-plus SQUARING has independent pivots (unlike FW), so rows
-    # are pulled a SUBLANE GROUP at a time: one masked reduce yields 8 rows
-    # (O(N^2) per chunk, O(N^3/8) total), then a static 8-way unroll of
-    # cheap register slices does the outer updates.
+def _chunked_squaring(d: jnp.ndarray, n: int, iters: int) -> jnp.ndarray:
+    """`iters` min-plus squarings of a symmetric (N, N) register value.
+
+    Mosaic has no dynamic_slice on a value held in registers, so pivot rows
+    are extracted with masked min-reduces (inert +inf elsewhere).  Doing
+    that per pivot costs O(N^2) VPU work per row — as much as the update
+    itself (round-3 verdict: the kernel lost to XLA below N=512 mostly on
+    this).  Min-plus SQUARING has independent pivots (unlike FW), so rows
+    are pulled a SUBLANE GROUP at a time: one masked reduce yields 8 rows
+    (O(N^2) per chunk, O(N^3/8) total), then a static 8-way unroll of
+    cheap register slices does the outer updates.  Shared by the dense-fed
+    (`_apsp_kernel`) and COO-fed (`_coo_apsp_kernel`) entry points."""
     c = _ROW_CHUNK
     nchunks = n // c
     chunk_ids = lax.broadcasted_iota(jnp.int32, (nchunks, 1, 1), 0)
@@ -71,7 +78,11 @@ def _apsp_kernel(d_ref, o_ref, *, n: int, iters: int):
 
         return lax.fori_loop(0, nchunks, chunk_body, dist)
 
-    o_ref[0] = lax.fori_loop(0, iters, squaring, d)
+    return lax.fori_loop(0, iters, squaring, d)
+
+
+def _apsp_kernel(d_ref, o_ref, *, n: int, iters: int):
+    o_ref[0] = _chunked_squaring(d_ref[0], n, iters)
 
 
 def minplus_power_kernel_call(
@@ -387,3 +398,186 @@ def apsp_minplus_pallas(
         out = blocked_fw_call(w, tile=_LANE, interpret=interpret)
     out = out[:, :n, :n]
     return out[0] if squeeze else out
+
+
+# --------------------------- COO-fed squaring -------------------------------
+#
+# Third regime: `--layout sparse` keeps the graph as a padded link list, but
+# until this kernel the APSP leg still scatter-built a dense (N, N) weight
+# matrix in XLA and ran the dense squaring on it.  Here the dense matrix
+# never exists in HBM: the kernel rebuilds W in registers from the (L,)
+# edge list (two masked min-extracts + one symmetric iota hit-mask per
+# edge, O(L*N^2) VPU work — small next to the squaring's O(N^3 log N)) and
+# then runs the shared sublane-chunked squaring in place.  Every step is an
+# exact fp min or the same fp adds as `env.apsp.apsp_minplus_blocked`, and
+# min-plus squaring of a bitwise-symmetric matrix stays bitwise symmetric
+# (a+b == b+a in IEEE), so the result is BIT-IDENTICAL to the scatter+XLA
+# reference — the full ceil(log2) schedule lands on the same fixed point
+# the reference's bitwise `nxt == cur` early-stop converges to.
+
+
+def _coo_apsp_kernel(us_ref, vs_ref, d_ref, o_ref, *, n: int, l: int,
+                     iters: int):
+    u_row = us_ref[0]                            # (1, Lp) int32
+    v_row = vs_ref[0]
+    d = d_ref[0]                                 # (1, Lp), +inf on pads
+    lane = lax.broadcasted_iota(jnp.int32, d.shape, 1)
+    ii = lax.broadcasted_iota(jnp.int32, (n, n), 0)
+    jj = lax.broadcasted_iota(jnp.int32, (n, n), 1)
+    big = jnp.iinfo(jnp.int32).max
+
+    def edge_body(e, w):
+        sel = lane == e                          # scalar extract via masked
+        u = jnp.min(jnp.where(sel, u_row, big))  # min-reduce: no dynamic
+        v = jnp.min(jnp.where(sel, v_row, big))  # slicing of register values
+        de = jnp.min(jnp.where(sel, d, jnp.inf))
+        hit = ((ii == u) & (jj == v)) | ((ii == v) & (jj == u))
+        return jnp.minimum(w, jnp.where(hit, de, jnp.inf))
+
+    w0 = jnp.where(ii == jj, 0.0, jnp.inf).astype(d.dtype)
+    w = lax.fori_loop(0, l, edge_body, w0)
+    o_ref[0] = _chunked_squaring(w, n, iters)
+
+
+def coo_apsp_cost_facts(n: int, l: int, iters: int,
+                        dtype_bytes: int = 4) -> dict:
+    """Analytic cost facts for the COO-fed kernel (EXECUTED work: the edge
+    walk is ~5 (N, N) VPU ops per link, the squaring ~2.25*N^3 per iter
+    counting the chunked row extraction) — `obs.prof.register_kernel`
+    feeds these to the MFU/HBM gauges, since Mosaic programs never pass
+    through XLA cost analysis."""
+    flops = 5.0 * l * n * n + iters * 2.25 * n ** 3
+    bytes_accessed = float(2 * l * 4 + l * dtype_bytes
+                           + n * n * dtype_bytes)
+    return {"flops": flops, "bytes_accessed": bytes_accessed,
+            "argument_bytes": float(2 * l * 4 + l * dtype_bytes)}
+
+
+_COO_REGISTERED: set = set()
+
+
+def _register_coo(n: int, l: int, iters: int, dtype_bytes: int) -> None:
+    key = (n, l, iters, dtype_bytes)
+    if key in _COO_REGISTERED:
+        return
+    _COO_REGISTERED.add(key)
+    from multihop_offload_tpu.obs.prof import register_kernel
+
+    register_kernel(
+        "ops/coo_apsp", **coo_apsp_cost_facts(n, l, iters, dtype_bytes),
+        labels={"kind": "pallas", "shape": f"n{n}_l{l}"})
+
+
+def coo_apsp_path(n: int, interpret: bool = False) -> str:
+    """Which implementation `apsp_minplus_coo` actually runs for node count
+    n: 'coo-squaring' | 'blocked-fw' | 'xla-fallback'.  Same honesty
+    contract as `pallas_apsp_path`; 'blocked-fw' means the dense weight
+    matrix is scatter-built on device and handed to the blocked-FW kernel
+    (the in-register rebuild only fits whole-matrix VMEM sizes)."""
+    if not interpret and not tpu_backend():
+        return "xla-fallback"
+    n_pad = max(_LANE, math.ceil(n / _LANE) * _LANE)
+    if n_pad <= _MAX_SQUARING_N:
+        return "coo-squaring"
+    if n_pad <= _MAX_BLOCKED_N:
+        return "blocked-fw"
+    return "xla-fallback"
+
+
+def apsp_minplus_coo(
+    link_ends: jnp.ndarray,
+    link_mask: jnp.ndarray,
+    link_delays: jnp.ndarray,
+    num_nodes: int,
+    num_iters: int | None = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """APSP fed straight from the padded COO link list.
+
+    Drop-in for the sparse layout's scatter+dense chain
+    (`layouts.sparse.weight_matrix_from_edges` -> `env.apsp.
+    apsp_minplus_blocked`) and BIT-IDENTICAL to it: masked links carry
+    +inf (inert under min), the in-register W build does the same exact
+    min-scatter, and the squaring schedule lands on the reference's
+    early-stop fixed point.  Unbatched (L, 2)/(L,) inputs only — batch via
+    `jax.vmap` (the Pallas batching rule turns it into a grid axis)."""
+    path = coo_apsp_path(num_nodes, interpret=interpret)
+    delays = jnp.where(link_mask, link_delays,
+                       jnp.asarray(jnp.inf, link_delays.dtype))
+    if path != "coo-squaring":
+        from multihop_offload_tpu.layouts.sparse import (
+            weight_matrix_from_edges,
+        )
+
+        w = weight_matrix_from_edges(link_ends, link_mask, link_delays,
+                                     num_nodes)
+        if path == "blocked-fw":
+            return apsp_minplus_pallas(w, num_iters, interpret=interpret)
+        from multihop_offload_tpu.env.apsp import apsp_minplus_blocked
+
+        return apsp_minplus_blocked(w, num_iters=num_iters)
+
+    n = num_nodes
+    (l, _) = link_ends.shape
+    n_pad = max(_LANE, math.ceil(n / _LANE) * _LANE)
+    l_pad = max(_LANE, math.ceil(l / _LANE) * _LANE)
+    iters = num_iters if num_iters is not None else max(
+        1, math.ceil(math.log2(max(n - 1, 2)))
+    )
+    _register_coo(n_pad, l_pad, iters, delays.dtype.itemsize)
+
+    us = jnp.zeros((1, 1, l_pad), jnp.int32).at[0, 0, :l].set(
+        link_ends[:, 0].astype(jnp.int32))
+    vs = jnp.zeros((1, 1, l_pad), jnp.int32).at[0, 0, :l].set(
+        link_ends[:, 1].astype(jnp.int32))
+    d = jnp.full((1, 1, l_pad), jnp.inf, delays.dtype).at[0, 0, :l].set(
+        delays)
+
+    kernel = functools.partial(_coo_apsp_kernel, n=n_pad, l=l, iters=iters)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((1, n_pad, n_pad), delays.dtype),
+        grid=(1,),
+        in_specs=[
+            pl.BlockSpec((1, 1, l_pad), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1, l_pad), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1, l_pad), lambda i: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, n_pad, n_pad), lambda i: (i, 0, 0)),
+        interpret=interpret,
+    )(us, vs, d)
+    return out[0, :n, :n]
+
+
+def resolve_coo_apsp(impl: str, n: int, interpret: bool = False):
+    """Resolve the config knob `apsp_impl` to a COO-fed APSP callable for
+    the sparse layout.
+
+    Returns ``(edges_fn, path)``.  ``edges_fn`` is None for the default
+    scatter+XLA chain (callers treat None as `weight_matrix_from_edges` +
+    `env.apsp.apsp_minplus_blocked`) and otherwise a drop-in
+    ``(link_ends, link_mask, link_delays, num_nodes) -> (N, N)`` running
+    `apsp_minplus_coo`.  'auto' follows the same measured
+    `_AUTO_PALLAS_MIN_N` crossover as `resolve_apsp` — the COO build feeds
+    the identical squaring kernel, so the dense-fed ladder
+    (`benchmarks/pallas_tpu.json`) is the evidence that transfers; the
+    in-step COO gate lives in `benchmarks/bench_matrix.json`
+    (`coo_apsp_perf`)."""
+    if impl not in ("xla", "pallas", "auto"):
+        raise ValueError(f"apsp_impl must be xla|pallas|auto, got '{impl}'")
+    if impl == "xla":
+        return None, "xla"
+
+    def fn(link_ends, link_mask, link_delays, num_nodes):
+        return apsp_minplus_coo(link_ends, link_mask, link_delays,
+                                num_nodes, interpret=interpret)
+
+    if impl == "auto":
+        n_pad = max(_LANE, math.ceil(n / _LANE) * _LANE)
+        if n_pad < _AUTO_PALLAS_MIN_N:
+            return None, "xla"
+        path = coo_apsp_path(n, interpret=interpret)
+        if path == "xla-fallback":
+            return None, path
+        return fn, path
+    return fn, coo_apsp_path(n, interpret=interpret)

@@ -11,12 +11,16 @@ Beyond the default-pad run (padded L=256, the production shape), the script
 also measures the **L=384/512 rungs**: the same in-step A/B with
 BENCH_PAD_L forcing the link pad, xla vs pallas legs (auto stops at the
 measured win, so the kernel must be forced to get a reading above it).
-These rungs are what places `_AUTO_FP_MAX_L` (ops/fixed_point.py) — the
-microbench ladder alone sits on the tunnel's dispatch floor and mis-ranks
-them (ADVICE r5).  Rungs are TPU-only: off-TPU both legs lower to the XLA
-scan and there is nothing to compare, so they are skipped and any committed
-TPU measurement in the existing artifact is preserved, never overwritten by
-a run that could not measure.
+These rungs place `_AUTO_FP_MAX_L` (ops/fixed_point.py) — the microbench
+ladder alone sits on the tunnel's dispatch floor and mis-ranks them
+(ADVICE r5).  They now also run as campaign legs of the matrix runner
+(`mho-bench --matrix`, gates `fp_rung_384`/`fp_rung_512` in
+`benchmarks/bench_matrix.json`), which is the preferred way to fill them:
+one chip session covers the whole knob cross-product.  This script stays
+as the standalone subprocess-isolated A/B.  Rungs are TPU-only: off-TPU
+both legs lower to the XLA scan and there is nothing to compare, so they
+are skipped and any committed TPU measurement in the existing artifact is
+preserved, never overwritten by a run that could not measure.
 
 Usage: python scripts/fp_ab.py
 """
@@ -152,7 +156,10 @@ def main() -> int:
     rec["rungs_note"] = (
         "in-step A/B at BENCH_PAD_L-forced link pads, xla vs pallas legs; "
         "the evidence that places _AUTO_FP_MAX_L (ops/fixed_point.py). A "
-        "null pallas_over_xla means the rung has no on-chip measurement yet."
+        "null pallas_over_xla means the rung has no on-chip measurement "
+        "yet; these rungs also run as fp_rung_384/fp_rung_512 campaign "
+        "legs of mho-bench --matrix (benchmarks/bench_matrix.json), the "
+        "preferred single-session way to fill them."
     )
     os.makedirs(os.path.dirname(OUT), exist_ok=True)
     with open(OUT, "w") as f:

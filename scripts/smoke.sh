@@ -46,7 +46,13 @@
 #   9. sharded serve smoke        — an OffloadService on a 4-chip mesh of
 #      virtual host devices (XLA_FLAGS=--xla_force_host_platform_device_
 #      count=8): serves a window and asserts >1 device actually computed
-#      the batch, read off the output arrays' sharding.
+#      the batch, read off the output arrays' sharding;
+#  10. mho-bench --matrix --smoke  — the gate-campaign runner on a tiny
+#      CPU cross-product (dense+sparse, bf16, fused-kernel and fp-rung
+#      legs in one process): asserts the bench_matrix.json record schema
+#      is complete, on-chip gates stay null off-TPU, shipped defaults
+#      stay fp32+dense, fallback paths are reported honestly, and zero
+#      unexpected retraces across legs.
 #
 # This is the tier-1-ADJACENT gate (ROADMAP "quick checks") — it does not
 # replace the pytest tier-1 run.
@@ -55,10 +61,10 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
-echo "== [1/9] lint =="
+echo "== [1/10] lint =="
 bash scripts/lint.sh
 
-echo "== [2/9] mho-lint (engine: clean repo + every rule fires on seeds) =="
+echo "== [2/10] mho-lint (engine: clean repo + every rule fires on seeds) =="
 python -m multihop_offload_tpu.analysis.cli --json >/dev/null
 python - <<'EOF'
 import json, subprocess, sys
@@ -73,7 +79,7 @@ assert not missing, f"rules silent on their seeded violations: {missing}"
 print(f"mho-lint: all {len(need)} repo rules fire on the seeded fixtures")
 EOF
 
-echo "== [3/9] mho-sim --smoke (+ device metrics in the run report) =="
+echo "== [3/10] mho-sim --smoke (+ device metrics in the run report) =="
 SIM_LOG="$(mktemp -d)/run.jsonl"
 python -m multihop_offload_tpu.cli.sim --smoke --obs_log "$SIM_LOG"
 python - "$SIM_LOG" <<'EOF'
@@ -101,22 +107,22 @@ assert host == dev, f"devmetrics diverge from SimState: host={host} dev={dev}"
 print(f"devmetrics == SimState: {host} (exact), report section present")
 EOF
 
-echo "== [4/9] mho-sim --smoke --layout sparse =="
+echo "== [4/10] mho-sim --smoke --layout sparse =="
 python -m multihop_offload_tpu.cli.sim --smoke --layout sparse
 
-echo "== [5/9] mho-loop --smoke =="
+echo "== [5/10] mho-loop --smoke =="
 python -m multihop_offload_tpu.cli.loop --smoke
 
-echo "== [6/9] mho-chaos --smoke =="
+echo "== [6/10] mho-chaos --smoke =="
 python -m multihop_offload_tpu.cli.chaos --smoke
 
-echo "== [7/9] mho-health --smoke =="
+echo "== [7/10] mho-health --smoke =="
 python -m multihop_offload_tpu.cli.health --smoke
 
-echo "== [8/9] mho-prof --smoke =="
+echo "== [8/10] mho-prof --smoke =="
 python -m multihop_offload_tpu.cli.prof --smoke
 
-echo "== [9/9] sharded serve smoke (8 virtual devices) =="
+echo "== [9/10] sharded serve smoke (8 virtual devices) =="
 XLA_FLAGS="--xla_force_host_platform_device_count=8" python - <<'PYEOF'
 from multihop_offload_tpu.cli.serve import build_service
 from multihop_offload_tpu.config import Config
@@ -134,5 +140,10 @@ assert used > 1, f"sharded dispatch used {used} device(s); expected > 1"
 print(f"sharded serve: {len(responses)} requests over {used} devices, "
       f"placement {service.planner.plan.describe()}")
 PYEOF
+
+echo "== [10/10] mho-bench --matrix --smoke =="
+# refreshes the committed benchmarks/bench_matrix.json (the CPU record IS
+# the committed artifact until a chip session fills the on-chip gates)
+python -m multihop_offload_tpu.cli.bench --matrix --smoke
 
 echo "smoke: all green"

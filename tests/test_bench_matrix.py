@@ -1,0 +1,156 @@
+"""Gate-flip logic of `mho-bench --matrix` + the committed record schema.
+
+The flip rules are load-bearing: they own the shipped `--precision` /
+`--layout` defaults (`multihop_offload_tpu/_defaults.json`, read by
+`config.shipped_defaults()`).  Fabricated records pin the contract: every
+gate passing flips the axis to auto; any null or failed gate leaves it
+conservative; a record missing gate keys flips NOTHING and emits a typed
+warning event.
+"""
+
+import json
+import os
+
+from multihop_offload_tpu.cli.bench import (
+    GATE_KEYS,
+    LAYOUT_GATES,
+    PRECISION_GATES,
+    apply_defaults,
+    flip_defaults,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_RECORD = os.path.join(_REPO, "benchmarks", "bench_matrix.json")
+
+_CONSERVATIVE = {"precision": "fp32", "layout": "dense"}
+
+
+def _all_pass():
+    return {k: {"criterion": "c", "measured": 1.0, "pass": True}
+            for k in GATE_KEYS}
+
+
+def test_gate_key_groups_are_consistent():
+    assert set(PRECISION_GATES) <= set(GATE_KEYS)
+    assert set(LAYOUT_GATES) <= set(GATE_KEYS)
+    assert not set(PRECISION_GATES) & set(LAYOUT_GATES)
+
+
+def test_flip_on_full_pass():
+    defaults, events = flip_defaults(_all_pass())
+    assert defaults == {"precision": "auto", "layout": "auto"}
+    assert events == []
+
+
+def test_null_or_failed_gate_blocks_its_axis_only():
+    g = _all_pass()
+    g["precision_perf"]["pass"] = None  # awaiting chip run
+    defaults, events = flip_defaults(g)
+    assert defaults == {"precision": "fp32", "layout": "auto"}
+    assert events == []
+
+    g = _all_pass()
+    g["layout_perf_tpu"]["pass"] = False
+    defaults, _ = flip_defaults(g)
+    assert defaults == {"precision": "auto", "layout": "dense"}
+
+    # kernel-impl / serving gates close backlog but never drive the flip
+    g = _all_pass()
+    for k in ("fp_rung_384", "fp_rung_512", "chebconv_perf",
+              "coo_apsp_perf", "serve_scaling"):
+        g[k]["pass"] = None
+    defaults, events = flip_defaults(g)
+    assert defaults == {"precision": "auto", "layout": "auto"}
+    assert events == []
+
+
+def test_partial_record_no_flip_and_typed_warning():
+    g = _all_pass()
+    del g["coo_apsp_perf"]
+    g["layout_ai"] = "not-a-gate-dict"
+    defaults, events = flip_defaults(g)
+    assert defaults == _CONSERVATIVE  # nothing flips on a partial record
+    assert len(events) == 1
+    assert events[0]["event"] == "warning"
+    assert events[0]["code"] == "partial_gate_record"
+    assert set(events[0]["missing"]) == {"coo_apsp_perf", "layout_ai"}
+
+    defaults, events = flip_defaults(None)
+    assert defaults == _CONSERVATIVE
+    assert events[0]["code"] == "invalid_gate_record"
+
+    # truthy-but-not-True pass values must not flip (None/False/1.0 ...)
+    g = _all_pass()
+    g["precision_parity"]["pass"] = 1.0
+    defaults, _ = flip_defaults(g)
+    assert defaults["precision"] == "fp32"
+
+
+def test_apply_defaults_round_trip(tmp_path):
+    p = tmp_path / "_defaults.json"
+    p.write_text(json.dumps(
+        {"precision": "fp32", "layout": "dense", "_comment": "keep me"}))
+    assert apply_defaults({"precision": "auto", "layout": "auto"}, str(p))
+    rec = json.loads(p.read_text())
+    assert rec["precision"] == "auto" and rec["layout"] == "auto"
+    assert rec["_comment"] == "keep me"
+    # idempotent: same defaults -> no rewrite
+    assert not apply_defaults({"precision": "auto", "layout": "auto"}, str(p))
+    # a regressed gate set downgrades (the flip is not a ratchet)
+    assert apply_defaults(dict(_CONSERVATIVE), str(p))
+    assert json.loads(p.read_text())["precision"] == "fp32"
+    # missing file: written fresh
+    q = tmp_path / "fresh.json"
+    assert apply_defaults(dict(_CONSERVATIVE), str(q))
+    assert json.loads(q.read_text())["layout"] == "dense"
+
+
+def test_committed_record_schema_round_trip():
+    """The committed campaign record must carry the full gate schema, and
+    re-running the pure flip logic on its gates must reproduce its own
+    committed defaults (no hidden state in the runner)."""
+    with open(_RECORD) as f:
+        rec = json.load(f)
+
+    for key in ("description", "platform", "legs", "gates",
+                "all_gates_pass", "defaults", "defaults_applied",
+                "unexpected_retraces", "events", "roofline", "workload"):
+        assert key in rec, f"record missing {key}"
+    assert set(GATE_KEYS) == set(rec["gates"])
+    for k, g in rec["gates"].items():
+        assert "criterion" in g and "measured" in g and "pass" in g, k
+
+    assert rec["unexpected_retraces"] == 0
+    for leg in rec["legs"].values():
+        assert leg["steps_per_sec"] > 0
+        assert set(leg["paths"]) == {"apsp", "fp", "cheb", "coo_apsp"}
+
+    if rec["platform"] != "tpu":
+        # null-preserving convention: chip gates stay null off-TPU (or are
+        # preserved verbatim from a committed TPU record)
+        for k, g in rec["gates"].items():
+            if "source" in g:
+                continue
+            assert g["pass"] is None or "preserved" in g.get("note", ""), k
+        assert rec["defaults"] == _CONSERVATIVE
+        assert rec["defaults_applied"] is False
+
+    defaults, events = flip_defaults(rec["gates"])
+    assert defaults == rec["defaults"]
+    assert not events
+
+
+def test_shipped_defaults_match_committed_record():
+    """config.shipped_defaults() (what drivers actually boot with) must
+    agree with the campaign record's verdict — the record owns the file."""
+    from multihop_offload_tpu.config import shipped_defaults
+
+    with open(_RECORD) as f:
+        rec = json.load(f)
+    shipped = shipped_defaults()
+    assert shipped["precision"] in ("fp32", "bf16", "auto")
+    assert shipped["layout"] in ("dense", "sparse", "auto")
+    if rec.get("defaults_applied"):
+        assert shipped == rec["defaults"]
+    else:
+        assert shipped == _CONSERVATIVE

@@ -1,0 +1,44 @@
+"""Every `[project.scripts]` target must resolve: import the module, find
+the callable.  A dangling entry point (the `mho-bench` gap this pins) only
+explodes at `pip install` + first invocation — too late.
+
+Python 3.10 has no tomllib, so the section is regex-parsed; the parse is
+itself asserted so a reformatted pyproject can't silently empty the list.
+"""
+
+import importlib
+import os
+import re
+
+import pytest
+
+_PYPROJECT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "pyproject.toml")
+
+
+def _script_targets():
+    with open(_PYPROJECT, encoding="utf-8") as f:
+        text = f.read()
+    m = re.search(r"\[project\.scripts\]\n(.*?)(?=\n\[)", text, re.S)
+    assert m, "pyproject.toml has no [project.scripts] section"
+    targets = re.findall(
+        r'^([A-Za-z0-9_-]+)\s*=\s*"([A-Za-z0-9_.]+):([A-Za-z0-9_]+)"',
+        m.group(1), re.M)
+    assert len(targets) >= 12, f"parsed only {len(targets)} script targets"
+    return targets
+
+
+def test_script_section_parses():
+    names = [t[0] for t in _script_targets()]
+    assert "mho-bench" in names  # the once-dangling entry point
+    assert len(names) == len(set(names))
+
+
+@pytest.mark.parametrize(
+    "script,module,func", _script_targets(), ids=[t[0] for t in _script_targets()]
+)
+def test_entry_point_resolves(script, module, func):
+    mod = importlib.import_module(module)
+    fn = getattr(mod, func, None)
+    assert callable(fn), f"{script}: {module}:{func} is not callable"

@@ -428,3 +428,304 @@ def test_fixed_point_pallas_under_vmap():
     )(jnp.asarray(lam))
     np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
                                rtol=1e-5, atol=1e-8)
+
+
+# ---- fused ChebConv tile + COO-fed APSP (ops.chebconv / ops.minplus) -------
+
+# the fused tile reassociates the fp32 edge reduction (one-hot matmuls vs
+# ordered segment-sum), so values/grads are compared SCALED: abs error over
+# max(1, max|ref|).  The bwd rule itself recomputes through the XLA
+# reference and is asserted bitwise cotangent-for-cotangent.
+_SCALED_TOL = 4.5e-7
+
+
+def _scaled_err(got, want):
+    got, want = np.asarray(got), np.asarray(want)
+    return float(np.abs(got - want).max()) / max(1.0, float(np.abs(want).max()))
+
+
+def _sparse_support_case(rng, e=48, f=8, pad_extra=5):
+    """A real Chebyshev support in edge-list form + features, with a few
+    inert padded edges (rows=cols=0, vals=0) as real instances carry."""
+    from multihop_offload_tpu.layouts.sparse import (
+        _coo_from_dense_np, sparse_chebyshev_support,
+    )
+    from multihop_offload_tpu.ops import COO
+
+    adj = np.triu(rng.uniform(size=(e, e)) < 0.15, 1)
+    adj = (adj + adj.T).astype(np.float32)
+    nnz = int(np.count_nonzero(adj))
+    coo_np = _coo_from_dense_np(adj, nnz + pad_extra, np.float32)
+    edges = COO(rows=jnp.asarray(coo_np.rows), cols=jnp.asarray(coo_np.cols),
+                vals=jnp.asarray(coo_np.vals), shape=coo_np.shape)
+    support = sparse_chebyshev_support(edges)
+    x = jnp.asarray((10.0 * rng.normal(size=(e, f))).astype(np.float32))
+    return support, x
+
+
+def test_fused_chebconv_matches_segment_sum():
+    """`make_fused_propagate` (interpret-mode Pallas) == the sparse layout's
+    gather+segment-sum: values at the scaled 4.5e-7 bar, bwd BITWISE for
+    identical cotangents, end-to-end grads back at the scaled bar (the
+    cotangent then flows through the fused forward)."""
+    import jax
+
+    from multihop_offload_tpu.layouts.sparse import (
+        SparseSupport, make_sparse_propagate,
+    )
+    from multihop_offload_tpu.ops import COO
+    from multihop_offload_tpu.ops.chebconv import make_fused_propagate
+
+    rng = np.random.default_rng(19)
+    support, x = _sparse_support_case(rng)
+    ref = make_sparse_propagate()
+    fused = make_fused_propagate(interpret=True)
+    want = ref(support, x)
+    got = jax.jit(fused)(support, x)
+    assert got.shape == want.shape and got.dtype == want.dtype
+    assert _scaled_err(got, want) <= _SCALED_TOL
+
+    e = support.edges
+
+    def run(prop, vals, diag, xx):
+        sup = SparseSupport(
+            edges=COO(rows=e.rows, cols=e.cols, vals=vals, shape=e.shape),
+            diag=diag,
+        )
+        return prop(sup, xx)
+
+    g = jnp.asarray(rng.normal(size=np.asarray(want).shape).astype(np.float32))
+    _, vjp_ref = jax.vjp(lambda v, d, xx: run(ref, v, d, xx),
+                         e.vals, support.diag, x)
+    _, vjp_fus = jax.vjp(lambda v, d, xx: run(fused, v, d, xx),
+                         e.vals, support.diag, x)
+    for a, b in zip(vjp_fus(g), vjp_ref(g)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def loss(prop):
+        return lambda v, d, xx: jnp.sum(run(prop, v, d, xx) ** 2)
+
+    gr = jax.grad(loss(ref), argnums=(0, 1, 2))(e.vals, support.diag, x)
+    gf = jax.grad(loss(fused), argnums=(0, 1, 2))(e.vals, support.diag, x)
+    for a, b in zip(gf, gr):
+        assert _scaled_err(a, b) <= _SCALED_TOL
+
+
+def test_fused_chebconv_under_vmap():
+    """The bench vmaps the step over episodes with the propagate bound —
+    vmap over the custom_vjp-wrapped pallas_call (values + grads)."""
+    import jax
+
+    from multihop_offload_tpu.layouts.sparse import (
+        SparseSupport, make_sparse_propagate,
+    )
+    from multihop_offload_tpu.ops import COO
+    from multihop_offload_tpu.ops.chebconv import make_fused_propagate
+
+    rng = np.random.default_rng(29)
+    support, x = _sparse_support_case(rng, e=32, f=4)
+    e = support.edges
+    b = 3
+    vals = jnp.stack([e.vals * (1.0 + 0.1 * i) for i in range(b)])
+    xs = jnp.stack([x * (1.0 - 0.2 * i) for i in range(b)])
+    ref = make_sparse_propagate()
+    fused = make_fused_propagate(interpret=True)
+
+    def run(prop, v, xx):
+        sup = SparseSupport(
+            edges=COO(rows=e.rows, cols=e.cols, vals=v, shape=e.shape),
+            diag=support.diag,
+        )
+        return prop(sup, xx)
+
+    want = jax.vmap(lambda v, xx: run(ref, v, xx))(vals, xs)
+    got = jax.vmap(lambda v, xx: run(fused, v, xx))(vals, xs)
+    assert _scaled_err(got, want) <= _SCALED_TOL
+
+    g_ref = jax.grad(lambda v: jnp.sum(
+        jax.vmap(lambda vv, xx: run(ref, vv, xx))(v, xs) ** 2))(vals)
+    g_fus = jax.grad(lambda v: jnp.sum(
+        jax.vmap(lambda vv, xx: run(fused, vv, xx))(v, xs) ** 2))(vals)
+    assert _scaled_err(g_fus, g_ref) <= _SCALED_TOL
+
+
+def test_resolve_chebconv_paths_and_fallback():
+    """Executed-path honesty (`pallas_apsp_path` contract) + the knob: the
+    off-TPU non-interpret wrapper must EXECUTE (XLA delegate, bitwise the
+    reference) while reporting 'xla-fallback'; 'auto' stays XLA until
+    bench_matrix.json records an on-chip chebconv_perf win."""
+    from multihop_offload_tpu.layouts.sparse import make_sparse_propagate
+    from multihop_offload_tpu.ops.chebconv import (
+        chebconv_path, resolve_chebconv,
+    )
+
+    assert chebconv_path(interpret=True) == "pallas"
+    assert chebconv_path() == "xla-fallback"  # CPU test environment
+
+    fn, path = resolve_chebconv("xla")
+    assert fn is None and path == "xla"
+    fn, path = resolve_chebconv("auto")
+    assert fn is None and path == "xla"  # auto stops at measured evidence
+    factory, path = resolve_chebconv("pallas", interpret=True)
+    assert callable(factory) and path == "pallas"
+    with pytest.raises(ValueError):
+        resolve_chebconv("bogus")
+
+    factory, path = resolve_chebconv("pallas")  # off-TPU, no interpret
+    assert path == "xla-fallback"
+    rng = np.random.default_rng(23)
+    support, x = _sparse_support_case(rng)
+    got = factory()(support, x)
+    want = make_sparse_propagate()(support, x)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_coo_apsp_bit_identical_to_scatter_chain():
+    """`apsp_minplus_coo` == scatter-build + `apsp_minplus_blocked`,
+    BITWISE: single, int16 link ends (sparse storage), vmap, and the
+    off-TPU fallback path."""
+    import jax
+
+    from multihop_offload_tpu.env.apsp import apsp_minplus_blocked
+    from multihop_offload_tpu.layouts import weight_matrix_from_edges
+    from multihop_offload_tpu.ops.minplus import apsp_minplus_coo
+
+    rng = np.random.default_rng(13)
+    n, l_pad = 40, 128
+    adj = np.triu(rng.uniform(size=(n, n)) < 0.12, 1)
+    us, vs = np.nonzero(adj)
+    l = us.size
+    assert 0 < l <= l_pad
+    ends = np.zeros((l_pad, 2), np.int32)
+    ends[:l, 0], ends[:l, 1] = us, vs
+    mask = jnp.asarray(np.arange(l_pad) < l)
+    ends = jnp.asarray(ends)
+    delays = jnp.asarray(rng.uniform(0.1, 3.0, l_pad).astype(np.float32))
+
+    want = np.asarray(apsp_minplus_blocked(
+        weight_matrix_from_edges(ends, mask, delays, n)))
+    got = np.asarray(apsp_minplus_coo(ends, mask, delays, n, interpret=True))
+    np.testing.assert_array_equal(got, want)
+
+    got16 = np.asarray(apsp_minplus_coo(
+        ends.astype(jnp.int16), mask, delays, n, interpret=True))
+    np.testing.assert_array_equal(got16, want)
+
+    b = 3
+    bd = jnp.stack([delays * (1.0 + 0.3 * i) for i in range(b)])
+    want_b = np.asarray(jax.vmap(
+        lambda d: apsp_minplus_blocked(
+            weight_matrix_from_edges(ends, mask, d, n)))(bd))
+    got_b = np.asarray(jax.vmap(
+        lambda d: apsp_minplus_coo(ends, mask, d, n, interpret=True))(bd))
+    np.testing.assert_array_equal(got_b, want_b)
+
+    # off-TPU without interpret: executes the scatter+XLA chain, bitwise
+    got_fb = np.asarray(apsp_minplus_coo(ends, mask, delays, n))
+    np.testing.assert_array_equal(got_fb, want)
+
+
+def test_coo_apsp_resolve_and_paths():
+    from multihop_offload_tpu.ops.minplus import (
+        coo_apsp_path, resolve_coo_apsp,
+    )
+
+    assert coo_apsp_path(150, interpret=True) == "coo-squaring"
+    assert coo_apsp_path(300, interpret=True) == "blocked-fw"
+    assert coo_apsp_path(3000, interpret=True) == "xla-fallback"
+    assert coo_apsp_path(150) == "xla-fallback"  # off-TPU dispatch honesty
+
+    fn, path = resolve_coo_apsp("xla", 150)
+    assert fn is None and path == "xla"
+    # 'auto' follows the same measured crossover as resolve_apsp
+    fn, path = resolve_coo_apsp("auto", 110, interpret=True)
+    assert fn is None and path == "xla"
+    fn, path = resolve_coo_apsp("auto", 256, interpret=True)
+    assert fn is not None and path == "coo-squaring"
+    fn, path = resolve_coo_apsp("pallas", 64, interpret=True)
+    assert fn is not None and path == "coo-squaring"
+    with pytest.raises(ValueError):
+        resolve_coo_apsp("bogus", 64)
+
+
+def test_pallas_kernels_register_with_prof():
+    """Both hand-written kernels must self-register analytic cost facts
+    with the prof layer (they never pass through XLA cost analysis)."""
+    import jax
+
+    from multihop_offload_tpu.obs.prof import prof_registry
+    from multihop_offload_tpu.ops.chebconv import make_fused_propagate
+    from multihop_offload_tpu.ops.minplus import apsp_minplus_coo
+
+    rng = np.random.default_rng(3)
+    support, x = _sparse_support_case(rng, e=16, f=4)
+    jax.block_until_ready(make_fused_propagate(interpret=True)(support, x))
+    ends = jnp.asarray([[0, 1], [1, 2], [2, 3]], jnp.int32)
+    mask = jnp.ones((3,), bool)
+    delays = jnp.ones((3,), jnp.float32)
+    jax.block_until_ready(apsp_minplus_coo(ends, mask, delays, 4,
+                                           interpret=True))
+
+    snap = prof_registry().snapshot()
+    for name in ("ops/chebconv", "ops/coo_apsp"):
+        assert name in snap, f"{name} not registered with obs/prof"
+        rec = snap[name]
+        for k in ("flops", "bytes_accessed", "arithmetic_intensity"):
+            assert rec.get(k), f"{name} missing {k}"
+
+
+def test_forward_backward_with_fused_chebconv():
+    """The step-form critic chain (forward_backward) under the sparse
+    layout with the fused propagate: decisions bit-identical, values and
+    parameter grads at the scaled 4.5e-7 bar."""
+    import jax
+
+    from multihop_offload_tpu.agent.train_step import forward_backward
+    from multihop_offload_tpu.graphs import generators
+    from multihop_offload_tpu.graphs.instance import (
+        PadSpec, build_instance, build_jobset,
+    )
+    from multihop_offload_tpu.graphs.topology import (
+        build_topology, sample_link_rates,
+    )
+    from multihop_offload_tpu.layouts import (
+        make_sparse_propagate, resolve_layout, zeros_support,
+    )
+    from multihop_offload_tpu.models import ChebNet
+    from multihop_offload_tpu.ops.chebconv import make_fused_propagate
+
+    lay = resolve_layout("sparse")
+    rng = np.random.default_rng(7)
+    # BA (the workload family the sparse nnz-pad heuristics are sized for)
+    adj, _ = generators.generate("ba", 24, seed=8)
+    topo = build_topology(adj)
+    roles = np.zeros(24, dtype=np.int32)
+    roles[[2, 9]] = 1
+    bws = np.where(roles == 1, 80.0, 4.0)
+    rates = sample_link_rates(topo, 50.0, rng=rng)
+    pad = PadSpec(n=24, l=PadSpec.round_up(topo.num_links, 8), s=8, j=8)
+    inst = build_instance(topo, roles, bws, rates, 1000.0, pad,
+                          dtype=np.float32, layout=lay)
+    mobile = np.flatnonzero(roles == 0)
+    jobs = build_jobset(mobile[:6], 0.15 * rng.uniform(0.1, 0.5, 6),
+                        pad_jobs=8, dtype=np.float32,
+                        index_dtype=lay.index_dtype)
+
+    model_ref = ChebNet(propagate=make_sparse_propagate())
+    model_fus = ChebNet(propagate=make_fused_propagate(interpret=True))
+    variables = model_ref.init(
+        jax.random.PRNGKey(0), jnp.zeros((pad.e, 4), jnp.float32),
+        zeros_support(pad, jnp.float32, lay),
+    )
+    key = jax.random.PRNGKey(4)
+    out_ref = forward_backward(model_ref, variables, inst, jobs, key,
+                               layout=lay)
+    out_fus = forward_backward(model_fus, variables, inst, jobs, key,
+                               layout=lay)
+    np.testing.assert_array_equal(np.asarray(out_ref.dst),
+                                  np.asarray(out_fus.dst))
+    assert _scaled_err(out_fus.delays.job_total,
+                       out_ref.delays.job_total) <= _SCALED_TOL
+    for gr, gf in zip(jax.tree_util.tree_leaves(out_ref.grads),
+                      jax.tree_util.tree_leaves(out_fus.grads)):
+        assert _scaled_err(gf, gr) <= _SCALED_TOL
