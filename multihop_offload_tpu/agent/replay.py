@@ -149,8 +149,16 @@ def replay_apply(
 
     Caller must ensure count >= batch (the reference returns NaN and skips
     otherwise — that check lives in the driver, where count is host-visible).
-    Returns (params, opt_state, mean sampled critic loss — what the
-    reference's `replay` reports, `:162-169`).
+
+    Non-finite containment: a sampled slot whose stored loss or grad pytree
+    is NaN/Inf is skipped-and-counted IN-JIT — params AND optimizer state
+    pass through untouched (one poisoned episode must not corrupt Adam's
+    moments), and the skip count rides the caller's existing `float(loss)`
+    sync boundary.
+
+    Returns (params, opt_state, mean sampled critic loss over the finite
+    samples — NaN when none were finite, matching the reference's `replay`
+    report `:162-169` — and the number of skipped samples).
     """
     capacity = mem.loss_critic.shape[0]
     # uniform sample w/o replacement over the filled prefix via Gumbel top-k
@@ -161,13 +169,29 @@ def replay_apply(
     _, idx = lax.top_k(scores, batch)
 
     def step(carry, i):
-        p, s = carry
+        p, s, nskip = carry
         g = jax.tree_util.tree_map(lambda buf: buf[i], mem.grads)
-        updates, s = optimizer.update(g, s, p)
-        p = optax.apply_updates(p, updates)
-        p = apply_max_norm_constraint(p, max_norm)
-        return (p, s), None
+        ok = jnp.isfinite(mem.loss_critic[i])
+        for leaf in jax.tree_util.tree_leaves(g):
+            ok = ok & jnp.all(jnp.isfinite(leaf))
+        updates, s_new = optimizer.update(g, s, p)
+        p_new = optax.apply_updates(p, updates)
+        p_new = apply_max_norm_constraint(p_new, max_norm)
+        # where-select whole trees: compiled shape never depends on `ok`
+        p = jax.tree_util.tree_map(
+            lambda new, old: jnp.where(ok, new, old), p_new, p)
+        s = jax.tree_util.tree_map(
+            lambda new, old: jnp.where(ok, new, old), s_new, s)
+        return (p, s, nskip + jnp.where(ok, 0, 1)), None
 
-    (params, opt_state), _ = lax.scan(step, (params, opt_state), idx)
-    mean_loss = jnp.mean(mem.loss_critic[idx])
-    return params, opt_state, mean_loss
+    (params, opt_state, skipped), _ = lax.scan(
+        step, (params, opt_state, jnp.int32(0)), idx)
+    lc = mem.loss_critic[idx]
+    fin = jnp.isfinite(lc)
+    nfin = jnp.sum(fin)
+    mean_loss = jnp.where(
+        nfin > 0,
+        jnp.sum(jnp.where(fin, lc, 0.0)) / jnp.maximum(nfin, 1),  # div-ok(clamped >= 1)
+        jnp.nan,
+    )
+    return params, opt_state, mean_loss, skipped

@@ -81,6 +81,7 @@ DM_LOSS_CRITIC_SUM = "mho_dev_train_loss_critic_sum"
 DM_LOSS_CRITIC_SQ = "mho_dev_train_loss_critic_sq_sum"
 DM_LOSS_MSE_SUM = "mho_dev_train_loss_mse_sum"
 DM_EPISODES = "mho_dev_train_episodes_total"
+DM_NONFINITE = "mho_dev_train_nonfinite_total"
 
 
 def train_devmetrics():
@@ -97,6 +98,10 @@ def train_devmetrics():
     dm.counter(DM_LOSS_MSE_SUM, "MSE-loss first moment accumulator",
                dtype=jnp.float32)  # fp32-island(same wide-accumulator contract)
     dm.counter(DM_EPISODES, "episodes accumulated into the moments")
+    # in-jit non-finite sentinel: episodes whose losses came back NaN/Inf —
+    # rides the same flush, pairs with the skip-and-count update guard
+    dm.counter(DM_NONFINITE,
+               "episodes with non-finite losses, counted in-program")
     return dm.freeze()
 
 

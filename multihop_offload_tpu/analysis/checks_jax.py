@@ -11,6 +11,10 @@ JX004 host sync         device read-backs inside the serve tick / train
 JX005 nondeterminism    wall-clock / global-RNG calls in library code —
                         clocks are injected (the health layer's
                         convention), RNG is seeded
+JX008 saturation div    unguarded `x / (1 - ...)` in the queueing-math
+                        dirs — the M/M/1 utilization denominator blows
+                        up to inf/NaN exactly at the saturated inputs
+                        the admission guards exist to keep out
 
 JX001 runs a small intraprocedural taint pass over each jit-reachable
 function (see `reachability`): values produced by `jax.*` calls are
@@ -426,6 +430,54 @@ def check_jx005(mod: ModuleCtx) -> Iterator[Finding]:
                 message=msg + ", or waive with '# nondet-ok(<why>)'",
                 snippet=_snippet(mod, node),
             )
+
+
+# ---------------------------------------------------------------------------
+# JX008 — unguarded saturation denominators in the queueing-math dirs
+# ---------------------------------------------------------------------------
+
+JX008_DIRS = ("env", "sim", "loop")
+
+
+def _has_one_minus(node: ast.AST) -> bool:
+    """Does the expression contain a top-level `1 - x` / `1.0 - x`?  Does
+    NOT descend into calls: a denominator wrapped in a guard
+    (`jnp.maximum(1 - rho, eps)`, `jnp.where(...)`) is the sanctioned fix
+    and must not fire."""
+    if isinstance(node, ast.Call):
+        return False
+    if (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub)
+            and isinstance(node.left, ast.Constant)
+            and node.left.value in (1, 1.0)):
+        return True
+    return any(_has_one_minus(c) for c in ast.iter_child_nodes(node))
+
+
+@rule(
+    id="JX008", severity="error",
+    scope="env/ sim/ loop/",
+    waiver="# div-ok(",
+    doc=("unguarded `x / (1 - ...)` division in a queueing-math dir — the "
+         "M/M/1 utilization denominator is 0 at rho=1 and negative past "
+         "it; clamp (jnp.maximum(1 - rho, eps)), select (jnp.where), or "
+         "prove the bound and waive"),
+    dirs=JX008_DIRS,
+)
+def check_jx008(mod: ModuleCtx) -> Iterator[Finding]:
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Div)):
+            continue
+        if not _has_one_minus(node.right):
+            continue
+        yield Finding(
+            rule="JX008", path=mod.path, line=node.lineno,
+            message=("division by an unguarded `1 - ...` saturation "
+                     "denominator — inf/NaN at utilization 1; clamp it "
+                     "(jnp.maximum(1 - rho, eps)) or select around it "
+                     "(jnp.where), or waive a proven-bounded site with "
+                     "'# div-ok(<why>)'"),
+            snippet=_snippet(mod, node),
+        )
 
 
 # ---------------------------------------------------------------------------

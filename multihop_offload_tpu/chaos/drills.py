@@ -3,9 +3,11 @@
 One `ChaosSmoke` run builds a single tiny compiled service (a manual
 clock, one bucket) and drives every drill against it — kill-and-restart
 of the flywheel at mid-refit / mid-promotion / mid-rollback sites,
-checkpoint truncation and bit-flip, event-log torn final record and
-missing segment, slow/stuck ticks through the watchdog, backward clock
-skew, and transient I/O errors through the retry/backoff machinery.
+checkpoint truncation and bit-flip, checksum-valid weight poisoning
+(refused by the semantic canary, not byte verification), event-log torn
+final record and missing segment, slow/stuck ticks through the watchdog,
+backward clock skew, and transient I/O errors through the retry/backoff
+machinery.
 
 Every drill returns a record `{name, injected, recovered, checks{...},
 ok}`; the smoke asserts three global invariants on top:
@@ -104,6 +106,8 @@ class ChaosSmoke:
         ex.variables = {"params": self.init_vars["params"]}
         ex.loaded_step = None
         ex.loaded_lineage = None
+        ex.canary = None
+        ex._canary_rejected.clear()
         self.service.stats = ServingStats()
         self.service.watchdog = None
         self.service._degraded_until.clear()
@@ -337,6 +341,133 @@ class ChaosSmoke:
             return 16
 
         return self._corrupt_and_reload("ckpt_bitflip", corrupt)
+
+    # ---- semantic weight-poison drills -------------------------------------
+    # the fault class the byte drills above CANNOT represent: the poisoned
+    # checkpoint is saved through the normal path, so its integrity checksum
+    # is perfectly valid — only the semantic canary can refuse it
+
+    def run_weight_poison_hot_reload(self) -> dict:
+        """A checksum-VALID NaN-poisoned checkpoint at step 2 must be
+        refused by the serve-side semantic gate at hot-reload: loaded step
+        stays 1, typed `canary_reject` event, NO quarantine (the bytes are
+        fine — quarantining them would hide the real fault class), and the
+        champion keeps serving GNN decisions."""
+        from multihop_offload_tpu import obs
+        from multihop_offload_tpu.loop.canary import CheckpointCanary
+        from multihop_offload_tpu.obs import events as obs_events
+        from multihop_offload_tpu.train import checkpoints as ckpt_lib
+
+        cfg = self._drill_cfg("poison_hot_reload")
+        runlog = obs.start_run(cfg, role="chaos")
+        ex = self.service.executor
+        try:
+            directory = self._bootstrap_dir(cfg)
+            canary = CheckpointCanary(self.service, self.pool, count=6,
+                                      seed=self.base.seed + 77)
+            canary.record_champion()
+            ex.canary = canary
+            poisoned = faults.poison_checkpoint(directory, mode="nan",
+                                                seed=self.base.seed)
+            checksum_valid = ckpt_lib.has_verified(directory, poisoned)
+            step = self.service.hot_reload(cfg.model_dir())
+            # a second poll must hit the cached rejection, not re-restore
+            step2 = self.service.hot_reload(cfg.model_dir())
+            served = self._serve_ids(cfg, id_offset=110_000)
+            events = list(obs_events.read_events(cfg.obs_log))
+            rejects = [e for e in events if e.get("event") == "canary_reject"]
+            rec = {
+                "name": "weight_poison_hot_reload",
+                "injected": f"checksum-valid NaN poison at step {poisoned}",
+                "recovered": True,
+                "checks": {
+                    "poison_passes_checksum": checksum_valid,
+                    "reload_refused": step is None and step2 is None,
+                    "stayed_on_champion": ex.loaded_step == 1,
+                    "canary_reject_event": len(rejects) >= 1
+                    and rejects[0].get("stage") == "hot_reload",
+                    "no_quarantine": not any(
+                        e.get("event") == "ckpt_quarantine" for e in events
+                    ),
+                    "still_gnn_on_champion": len(served) > 0 and all(
+                        r.served_by == "gnn" for r in served.values()
+                    ),
+                },
+            }
+        finally:
+            ex.canary = None
+            ex._canary_rejected.clear()
+            obs.finish_run(runlog)
+        return self._finish(rec)
+
+    def run_weight_poison_promotion(self) -> dict:
+        """The same fault class offered through the flywheel's front door:
+        a NaN-poisoned candidate handed to `PromotionController.promote`
+        with the canary must be refused BEFORE the write-ahead `promoting`
+        intent — journaled `canarying` then `rejected`, no serving step
+        pinned, champion untouched."""
+        import jax
+
+        from multihop_offload_tpu import obs
+        from multihop_offload_tpu.loop.canary import CheckpointCanary
+        from multihop_offload_tpu.loop.promote import PromotionController
+        from multihop_offload_tpu.obs import events as obs_events
+
+        cfg = self._drill_cfg("poison_promotion")
+        runlog = obs.start_run(cfg, role="chaos")
+        try:
+            self._bootstrap_dir(cfg)
+            canary = CheckpointCanary(self.service, self.pool, count=6,
+                                      seed=self.base.seed + 78)
+            canary.record_champion()
+            rng = np.random.default_rng(self.base.seed)
+
+            def nan_poison(x):
+                a = np.array(x, copy=True)
+                if np.issubdtype(a.dtype, np.floating):
+                    flat = a.reshape(-1)
+                    idx = rng.choice(flat.size, size=max(flat.size // 4, 1),
+                                     replace=False)
+                    flat[idx] = np.nan
+                return a
+
+            host = jax.tree_util.tree_map(
+                np.asarray, self.service.executor.variables
+            )
+            candidate = {"params": jax.tree_util.tree_map(
+                nan_poison, host["params"]
+            )}
+            ctl = PromotionController(cfg.model_dir())
+            before = self.service.executor.loaded_step
+            got = ctl.promote(self.service, candidate, candidate_step=2,
+                              canary=canary)
+            served = self._serve_ids(cfg, id_offset=120_000)
+            rejects = [e for e in obs_events.read_events(cfg.obs_log)
+                       if e.get("event") == "canary_reject"]
+            states = [h["state"] for h in ctl.history]
+            rec = {
+                "name": "weight_poison_promotion",
+                "injected": "NaN-poisoned candidate offered for promotion",
+                "recovered": True,
+                "checks": {
+                    "promotion_refused": got is None
+                    and ctl.state == "rejected",
+                    "canarying_journaled": states[:2]
+                    == ["canarying", "rejected"],
+                    "no_serving_step_pinned":
+                        self.service.executor.loaded_step == before,
+                    "canary_reject_event": len(rejects) >= 1
+                    and rejects[0].get("stage") == "promote",
+                    "typed_reason": len(rejects) >= 1
+                    and rejects[0].get("reason") == "nonfinite_probe_outputs",
+                    "champion_still_serving": len(served) > 0 and all(
+                        r.served_by == "gnn" for r in served.values()
+                    ),
+                },
+            }
+        finally:
+            obs.finish_run(runlog)
+        return self._finish(rec)
 
     # ---- event-log drills --------------------------------------------------
 
@@ -773,6 +904,8 @@ class ChaosSmoke:
             self.run_kill(site)
         self.run_ckpt_truncation()
         self.run_ckpt_bitflip()
+        self.run_weight_poison_hot_reload()
+        self.run_weight_poison_promotion()
         self.run_log_torn_record()
         self.run_log_missing_segment()
         self.run_stuck_tick()
@@ -788,6 +921,8 @@ class ChaosSmoke:
             "counters": {
                 "quarantined": int(reg.counter(
                     "mho_ckpt_quarantined_total").total()),
+                "canary_rejections": int(reg.counter(
+                    "mho_canary_rejections_total").total()),
                 "io_retries": int(reg.counter(
                     "mho_io_retries_total").total()),
                 "watchdog_slow": int(reg.counter(

@@ -135,3 +135,120 @@ def torn_tail(path: str, garbage: bytes = b'{"event": "tick", "ts\xff\xfe') -> N
     newline)."""
     with open(path, "ab") as f:
         f.write(garbage)
+
+
+# ---- semantic fault families -----------------------------------------------
+# The corruption helpers above break BYTES; these break MEANING.  A
+# weight-poisoned checkpoint is saved through the normal path and therefore
+# carries a perfectly valid integrity checksum — it is exactly the fault
+# class `train.checkpoints.restore_verified` cannot see and the semantic
+# canary (`loop.canary`) exists to catch.  The request mutations produce
+# OffloadRequests that are shape-compatible with the buckets but
+# semantically wrong — the admission guards' (`serve.guards`) fault diet.
+
+POISON_MODES = ("nan", "inf", "scale")
+
+
+def poison_checkpoint(directory: str, mode: str = "nan", seed: int = 0,
+                      fraction: float = 0.25) -> int:
+    """Save a weight-poisoned — but checksum-VALID — checkpoint at
+    `latest+1` of an orbax tree.
+
+    Restores the latest verified step, poisons `fraction` of each float
+    leaf's entries (seeded): NaN / Inf injection, or a 1e6 scale blowup
+    (finite, so finiteness checks alone miss it — only the canary's
+    decision-agreement probe can).  The poisoned tree goes through the
+    NORMAL `save_checkpoint` path, so it gets a fresh, valid integrity
+    checksum and `source="poison"` lineage; orbax keeps the first save per
+    step id, hence the new step.  Returns the poisoned step id."""
+    import numpy as np
+
+    from multihop_offload_tpu.train import checkpoints as ckpt_lib
+
+    if mode not in POISON_MODES:
+        raise ValueError(f"unknown poison mode '{mode}'; one of {POISON_MODES}")
+    restored, step = ckpt_lib.restore_verified(directory)
+    if restored is None:
+        raise ValueError(f"no verified checkpoint to poison in {directory}")
+    rng = np.random.default_rng(seed)
+
+    def poison(x):
+        a = np.array(x, copy=True)
+        if not np.issubdtype(a.dtype, np.floating):
+            return a
+        flat = a.reshape(-1)
+        k = max(int(flat.size * fraction), 1)
+        idx = rng.choice(flat.size, size=min(k, flat.size), replace=False)
+        if mode == "nan":
+            flat[idx] = np.nan
+        elif mode == "inf":
+            flat[idx] = np.inf
+        else:
+            flat[idx] = flat[idx] * 1e6
+        return a
+
+    import jax
+
+    poisoned = jax.tree_util.tree_map(poison, restored)
+    new_step = step + 1
+    ckpt_lib.save_checkpoint(
+        directory, new_step, poisoned,
+        lineage=ckpt_lib.make_lineage(
+            "poison", parent_step=step, parent_dir=directory,
+            extra={"poison": mode, "fraction": fraction, "seed": seed},
+        ),
+    )
+    return new_step
+
+
+# request mutations: name -> expected admission-guard rejection reason
+REQUEST_MUTATIONS = (
+    ("nan_rate", "nonfinite"),
+    ("negative_rate", "nonpositive_rate"),
+    ("oob_src", "bad_node_id"),
+    ("relay_src", "bad_role"),
+    ("len_mismatch", "bad_shape"),
+    ("nonfinite_bw", "nonfinite"),
+    ("saturated", "saturated"),
+)
+
+
+def fuzz_request(req, mutation: str, seed: int = 0):
+    """Return a semantically-broken copy of a VALID OffloadRequest.
+
+    Each mutation is minimal — one field family perturbed — so the
+    admission guards' typed `reason` is predictable (the second element of
+    the matching `REQUEST_MUTATIONS` row); everything else stays
+    bit-identical to the input."""
+    import dataclasses as _dc
+
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    job_rate = np.array(req.job_rate, dtype=np.float64, copy=True)
+    if mutation == "nan_rate":
+        job_rate[rng.integers(job_rate.size)] = np.nan
+        return _dc.replace(req, job_rate=job_rate)
+    if mutation == "negative_rate":
+        job_rate[rng.integers(job_rate.size)] = -0.25
+        return _dc.replace(req, job_rate=job_rate)
+    if mutation == "oob_src":
+        job_src = np.array(req.job_src, copy=True)
+        job_src[rng.integers(job_src.size)] = req.topo.n + 7
+        return _dc.replace(req, job_src=job_src)
+    if mutation == "relay_src":
+        # point one job at a non-mobile node: valid id, wrong role
+        non_mobile = np.flatnonzero(np.asarray(req.roles) != 0)
+        job_src = np.array(req.job_src, copy=True)
+        job_src[rng.integers(job_src.size)] = int(non_mobile[-1])
+        return _dc.replace(req, job_src=job_src)
+    if mutation == "len_mismatch":
+        return _dc.replace(req, job_rate=job_rate[:-1])
+    if mutation == "nonfinite_bw":
+        proc = np.array(req.proc_bws, dtype=np.float64, copy=True)
+        proc[rng.integers(proc.size)] = np.inf
+        return _dc.replace(req, proc_bws=proc)
+    if mutation == "saturated":
+        return _dc.replace(req, job_rate=job_rate * 1e9)
+    raise ValueError(f"unknown request mutation '{mutation}'; one of "
+                     f"{[m for m, _ in REQUEST_MUTATIONS]}")

@@ -1,0 +1,360 @@
+"""The input-fuzzing smoke: semantic garbage in, typed rejections out.
+
+One `FuzzSmoke` run builds a single tiny compiled service and throws the
+whole `faults.REQUEST_MUTATIONS` catalogue at its front door — NaN and
+negative rates, out-of-range and wrong-role sources, length mismatches,
+non-finite bandwidths, saturating load — across several seeds each,
+interleaved with valid traffic.  Four invariants make it a guardrail
+proof rather than a crash hunt:
+
+- zero uncontained faults: no fuzzed input ever raises out of `submit`
+  or reaches a compiled program; every one is refused at admission with
+  the typed `reason` its mutation predicts (`serve.guards`);
+- valid traffic unperturbed: the same valid request ids served before,
+  among, and after the garbage produce bit-identical decisions — the
+  guards add a veto, never a perturbation;
+- conservation: every admitted request is answered exactly once
+  (admitted == served, queue drains to zero) and every fuzzed one is
+  counted in `rejected_invalid` / `mho_serve_rejected_total`;
+- zero unexpected retraces: garbage at the edge never reshapes the
+  compiled programs (`obs.jaxhooks` steady-state discipline).
+
+Two weight-surface legs ride along so `mho-fuzz --smoke` is the one
+self-contained guardrail record: a checksum-valid NaN-poisoned
+checkpoint refused by the semantic canary at hot-reload, and
+byte-corrupt checkpoints quarantined by verification — the two halves
+(semantic vs byte) of the poisoned-weights fault class.  The committed
+record is `benchmarks/fuzz_smoke.json`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Callable
+
+import numpy as np
+
+from multihop_offload_tpu.chaos import faults
+from multihop_offload_tpu.config import Config
+
+FUZZ_SEEDS = (0, 1, 2)
+
+
+def fuzz_config(cfg: Config, tmp: str) -> Config:
+    """Tiny two-bucket service shared by every leg: small enough to
+    compile in seconds on CPU, two buckets so routing stays exercised."""
+    return dataclasses.replace(
+        cfg,
+        serve_sizes="10,14", serve_buckets=2, serve_slots=4,
+        serve_queue_cap=64, serve_deadline_s=60.0,
+        model_root=os.path.join(tmp, "model"),
+        obs_log=os.path.join(tmp, "fuzz_run.jsonl"),
+        loop_capture_sample=0.0,
+        io_retries=3, io_backoff_s=0.0,
+    )
+
+
+class FuzzSmoke:
+    """State shared across the legs: ONE compiled service, one registry."""
+
+    def __init__(self, cfg: Config, tmp: str):
+        from multihop_offload_tpu.cli.serve import build_service
+
+        self.tmp = tmp
+        self.base = fuzz_config(cfg, tmp)
+        self.t = {"now": 0.0}
+        self.clock: Callable[[], float] = lambda: self.t["now"]
+        self.service, self.pool = build_service(self.base, clock=self.clock)
+        self.legs: list = []
+
+    # ---- shared plumbing ---------------------------------------------------
+
+    def _stream(self, count: int, id_offset: int) -> list:
+        from multihop_offload_tpu.serve.workload import request_stream
+
+        cfg = self.base
+        return list(request_stream(
+            self.pool, count, seed=cfg.seed + 1 + id_offset,
+            arrival_scale=cfg.arrival_scale, ul=cfg.ul_data, dl=cfg.dl_data,
+            t_max=float(cfg.T), id_offset=id_offset,
+        ))
+
+    def _serve(self, reqs: list) -> dict:
+        """Closed loop over `reqs`; returns {request_id: response}.  Only
+        backpressure is retried — anything else dropped is the drop the
+        leg is asserting on."""
+        pending = list(reqs)
+        pending.reverse()
+        out = {}
+        while pending or self.service.queue_depth:
+            while pending:
+                req = pending.pop()
+                if not self.service.submit(req):
+                    if self.service.last_submit_outcome == "backpressure":
+                        pending.append(req)
+                    break
+            for r in self.service.tick():
+                out[r.request_id] = r
+        return out
+
+    def _finish(self, rec: dict) -> dict:
+        rec["ok"] = all(rec["checks"].values())
+        self.legs.append(rec)
+        return rec
+
+    # ---- legs --------------------------------------------------------------
+
+    def run_typed_rejections(self) -> dict:
+        """Every mutation family x seed: the guard must refuse it with
+        exactly the reason the catalogue predicts, both through the pure
+        validator and through the full `submit` path."""
+        from multihop_offload_tpu.obs.registry import registry as obs_registry
+        from multihop_offload_tpu.serve.guards import validate_request
+
+        reg = obs_registry()
+        before = reg.counter("mho_serve_rejected_total").total()
+        invalid_before = self.service.stats.invalid
+        cases = []
+        uncontained = 0
+        for i, (mutation, want) in enumerate(faults.REQUEST_MUTATIONS):
+            for seed in FUZZ_SEEDS:
+                base = self._stream(1, id_offset=200_000 + 100 * i + seed)[0]
+                assert validate_request(base) is None
+                try:
+                    bad = faults.fuzz_request(base, mutation, seed=seed)
+                    rej = validate_request(bad)
+                    admitted = self.service.submit(bad)
+                except Exception as e:  # swallow-ok(the leg's whole point: an escape IS the recorded failure)
+                    uncontained += 1
+                    cases.append({"mutation": mutation, "seed": seed,
+                                  "error": repr(e)})
+                    continue
+                cases.append({
+                    "mutation": mutation, "seed": seed,
+                    "want": want,
+                    "got": rej.reason if rej is not None else None,
+                    "submit_refused": not admitted,
+                    "outcome": self.service.last_submit_outcome,
+                })
+        n = len(faults.REQUEST_MUTATIONS) * len(FUZZ_SEEDS)
+        after = reg.counter("mho_serve_rejected_total").total()
+        rec = {
+            "name": "typed_rejections",
+            "injected": f"{n} fuzzed requests "
+                        f"({len(faults.REQUEST_MUTATIONS)} mutation "
+                        f"families x {len(FUZZ_SEEDS)} seeds)",
+            "cases": cases,
+            "checks": {
+                "zero_uncontained": uncontained == 0,
+                "all_refused": all(c.get("submit_refused") for c in cases),
+                "typed_reasons_match": all(
+                    c.get("got") == c.get("want") for c in cases
+                ),
+                "outcome_recorded": all(
+                    c.get("outcome") == "rejected_invalid" for c in cases
+                ),
+                "stats_counted":
+                    self.service.stats.invalid - invalid_before == n,
+                "registry_counted": int(after - before) == n,
+            },
+        }
+        return self._finish(rec)
+
+    def run_valid_bit_parity(self) -> dict:
+        """The SAME valid request ids served clean, then re-served with
+        fuzzed garbage interleaved: decisions must be bit-identical
+        (decisions are PRNG-keyed by request id) — the guards veto, they
+        never perturb."""
+        reqs = self._stream(8, id_offset=210_000)
+        control = self._serve(list(reqs))
+        # interleave one fuzzed copy of each valid request into the replay
+        mixed, garbage = [], 0
+        for k, req in enumerate(reqs):
+            mixed.append(req)
+            mutation = faults.REQUEST_MUTATIONS[
+                k % len(faults.REQUEST_MUTATIONS)][0]
+            mixed.append(faults.fuzz_request(req, mutation, seed=k))
+            garbage += 1
+        replay = self._serve(mixed)
+        parity = {
+            rid: bool(np.array_equal(replay[rid].dst, control[rid].dst)
+                      and np.array_equal(replay[rid].is_local,
+                                         control[rid].is_local))
+            for rid in control
+            if rid in replay
+        }
+        rec = {
+            "name": "valid_bit_parity",
+            "injected": f"{garbage} fuzzed requests interleaved with "
+                        f"{len(reqs)} valid replays",
+            "checks": {
+                "all_valid_served": len(parity) == len(control) == len(reqs),
+                "decisions_bit_identical": bool(parity)
+                and all(parity.values()),
+                "all_gnn": all(r.served_by == "gnn"
+                               for r in replay.values()),
+            },
+        }
+        return self._finish(rec)
+
+    def run_conservation(self) -> dict:
+        """Across everything this smoke has thrown at the service: every
+        admitted request answered exactly once, queue drained, every
+        fuzzed one counted — nothing lost, nothing double-served."""
+        s = self.service.stats.summary()
+        rec = {
+            "name": "conservation",
+            "injected": None,
+            "summary": {k: s[k] for k in ("admitted", "served",
+                                          "rejected_invalid",
+                                          "rejected_backpressure",
+                                          "rejected_too_large")},
+            "checks": {
+                "admitted_eq_served": s["admitted"] == s["served"],
+                "queue_drained": self.service.queue_depth == 0,
+                "rejections_counted": s["rejected_invalid"] > 0,
+            },
+        }
+        return self._finish(rec)
+
+    def run_poisoned_checkpoint(self) -> dict:
+        """The weight surface: a checksum-valid NaN-poisoned checkpoint
+        must be refused at hot-reload (semantic gate), champion untouched
+        and still serving."""
+        import jax
+
+        from multihop_offload_tpu.loop.canary import CheckpointCanary
+        from multihop_offload_tpu.train import checkpoints as ckpt_lib
+
+        cfg = self.base
+        directory = os.path.join(cfg.model_dir(), "orbax")
+        ex = self.service.executor
+        host = jax.tree_util.tree_map(np.asarray, ex.variables)
+        ckpt_lib.save_checkpoint(
+            directory, 1, {"params": host["params"]},
+            lineage=ckpt_lib.make_lineage("offline"),
+        )
+        champion = self.service.hot_reload(cfg.model_dir())
+        canary = CheckpointCanary(self.service, self.pool, count=6,
+                                  seed=cfg.seed + 77)
+        canary.record_champion()
+        ex.canary = canary
+        try:
+            poisoned = faults.poison_checkpoint(directory, mode="nan",
+                                                seed=cfg.seed)
+            checksum_valid = ckpt_lib.has_verified(directory, poisoned)
+            step = self.service.hot_reload(cfg.model_dir())
+            served = self._serve(self._stream(4, id_offset=220_000))
+        finally:
+            ex.canary = None
+            ex._canary_rejected.clear()
+        rec = {
+            "name": "poisoned_checkpoint",
+            "injected": f"checksum-valid NaN poison at step {poisoned}",
+            "checks": {
+                "champion_loaded": champion == 1,
+                "poison_passes_checksum": checksum_valid,
+                "reload_refused": step is None and ex.loaded_step == 1,
+                "champion_still_serving": len(served) == 4 and all(
+                    r.served_by == "gnn" for r in served.values()
+                ),
+            },
+        }
+        return self._finish(rec)
+
+    def run_corrupt_bytes(self) -> dict:
+        """The other half of the weight surface: byte corruption (a
+        truncated step) is caught by integrity verification and
+        quarantined — the canary never even runs."""
+        import jax
+
+        from multihop_offload_tpu.train import checkpoints as ckpt_lib
+
+        cfg = self.base
+        directory = os.path.join(cfg.model_dir(), "orbax")
+        ex = self.service.executor
+        host = jax.tree_util.tree_map(np.asarray, ex.variables)
+        step = (ckpt_lib.latest_step(directory) or 0) + 1
+        ckpt_lib.save_checkpoint(
+            directory, step, {"params": host["params"]},
+            lineage=ckpt_lib.make_lineage("refit"),
+        )
+        n = 0
+        for root, _, files in os.walk(os.path.join(directory, str(step))):
+            for f in files:
+                p = os.path.join(root, f)
+                if os.path.getsize(p) > 0:
+                    faults.truncate_file(p, keep_fraction=0.3)
+                    n += 1
+        got = self.service.hot_reload(cfg.model_dir())
+        served = self._serve(self._stream(4, id_offset=230_000))
+        rec = {
+            "name": "corrupt_bytes",
+            "injected": f"{n} files truncated at step {step}",
+            "checks": {
+                "stayed_on_last_good": got in (None, 1)
+                and ex.loaded_step == 1,
+                "quarantine_dir_populated": bool(os.listdir(
+                    os.path.join(directory, "quarantine"))),
+                "kept_serving": len(served) == 4,
+            },
+        }
+        return self._finish(rec)
+
+    # ---- the matrix --------------------------------------------------------
+
+    def run_all(self) -> dict:
+        from multihop_offload_tpu.obs import jaxhooks
+        from multihop_offload_tpu.obs.registry import registry as obs_registry
+
+        # warm the compiled programs with one clean window, then freeze:
+        # nothing the fuzz throws afterwards may trace a new program
+        jaxhooks.install()
+        self._serve(self._stream(4, id_offset=190_000))
+        jaxhooks.mark_steady()
+        try:
+            self.run_typed_rejections()
+            self.run_valid_bit_parity()
+            self.run_poisoned_checkpoint()
+            self.run_corrupt_bytes()
+            self.run_conservation()
+            retraces = jaxhooks.unexpected_retraces()
+        finally:
+            jaxhooks.clear_steady()
+        reg = obs_registry()
+        record = {
+            "legs": self.legs,
+            "counters": {
+                "rejected_invalid": int(reg.counter(
+                    "mho_serve_rejected_total").total()),
+                "canary_rejections": int(reg.counter(
+                    "mho_canary_rejections_total").total()),
+                "quarantined": int(reg.counter(
+                    "mho_ckpt_quarantined_total").total()),
+                "serve_nonfinite": int(reg.counter(
+                    "mho_dev_serve_nonfinite_total").total()),
+            },
+            "checks": {
+                "all_legs_ok": all(leg["ok"] for leg in self.legs),
+                "leg_count": len(self.legs),
+                "zero_unexpected_retraces": retraces == 0,
+                "zero_live_nonfinite": int(reg.counter(
+                    "mho_dev_serve_nonfinite_total").total()) == 0,
+            },
+        }
+        record["ok"] = all(record["checks"].values())
+        return record
+
+
+def run_smoke(cfg: Config) -> dict:
+    """The full fuzz matrix in one temp tree; asserts every leg's checks.
+    The committed record is `benchmarks/fuzz_smoke.json`."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory(prefix="mho_fuzz_smoke_") as tmp:
+        harness = FuzzSmoke(cfg, tmp)
+        record = harness.run_all()
+    failed = [leg["name"] for leg in record["legs"] if not leg["ok"]]
+    assert record["ok"], f"fuzz smoke failed: {failed or record['checks']}"
+    return record

@@ -5,8 +5,9 @@
 
 The smoke run is the repo's crash-safety proof: every drill in
 `chaos.drills` injects one fault class (kill-and-restart mid-refit /
-mid-promotion / mid-rollback, checkpoint truncation and bit-flip, torn
-and missing event-log segments, stuck ticks, clock skew, transient I/O)
+mid-promotion / mid-rollback, checkpoint truncation and bit-flip,
+checksum-valid weight poisoning, torn and missing event-log segments,
+stuck ticks, clock skew, transient I/O)
 and asserts the matching recovery — journal resume to the same terminal
 state and lineage, quarantine + last-good fallback, reader continuation,
 watchdog degrade-then-recover, retry absorption — plus the global
@@ -43,6 +44,10 @@ FAULT_SITES = (
     ("journal:write", "transient I/O", "OSError writing the loop journal"),
     ("events:write", "transient I/O", "OSError writing the run log"),
     ("hot_reload", "transient I/O", "OSError during serve hot-reload"),
+    ("ckpt:poison", "semantic", "checksum-valid NaN/Inf/scale weight "
+                                "poison (faults.poison_checkpoint)"),
+    ("request:fuzz", "semantic", "shape-compatible but invalid requests "
+                                 "(faults.fuzz_request)"),
 )
 
 

@@ -75,8 +75,8 @@ def _capture_window(cfg: Config, service, pool, count: int, id_offset: int,
         while pending:
             req = pending.pop()
             if not service.submit(req):
-                if service.buckets.bucket_for(*req.sizes) is not None:
-                    pending.append(req)
+                if service.last_submit_outcome == "backpressure":
+                    pending.append(req)   # retryable after the next tick
                 break
         responses.extend(service.tick())
     return responses, id_offset + count
@@ -96,8 +96,8 @@ def _window_tau(responses):
 # resumed cycle still has to run (terminal states are not in here — a
 # resume on them starts the next cycle fresh)
 _PHASE_ORDER = {
-    "capturing": 0, "refitting": 1, "validating": 2, "promoting": 3,
-    "promoted": 4, "monitoring": 5, "rolling_back": 6,
+    "capturing": 0, "refitting": 1, "validating": 2, "canarying": 3,
+    "promoting": 3, "promoted": 4, "monitoring": 5, "rolling_back": 6,
 }
 
 
@@ -113,6 +113,7 @@ def run_cycle(
     steady_after_validate: bool = False,
     drift_monitor=None,
     resume_state=None,
+    canary=None,
 ):
     """One full flywheel cycle; returns (record, next_id_offset).
 
@@ -278,6 +279,7 @@ def run_cycle(
             experience_ids=[o.request.request_id for o in train],
             step=(controller.ctx.get("step")
                   if resume_state == "promoting" else None),
+            canary=canary,
         )
         record["promoted_step"] = step
         if step is None:
@@ -344,7 +346,7 @@ def run_cycle(
 
 def run_loop(cfg: Config, inject_regression: bool = False,
              steady_after_validate: bool = False, service=None,
-             pool=None, controller=None) -> dict:
+             pool=None, controller=None, drain=None) -> dict:
     """Build the service + controller and run `cfg.loop_cycles` cycles.
 
     The controller comes back through `PromotionController.resume`: when
@@ -352,8 +354,12 @@ def run_loop(cfg: Config, inject_regression: bool = False,
     cycle here continues from that journaled phase instead of restarting,
     and a journaled cool-down (post-rollback) blocks new cycles until it
     expires.  `service`/`pool`/`controller` are injectable so the chaos
-    drills can restart "the process" against one compiled service."""
+    drills can restart "the process" against one compiled service.
+    `drain` (a `utils.signals.GracefulDrain`) stops BETWEEN cycles on
+    SIGTERM/SIGINT — every transition is already journaled, so the next
+    process resumes cleanly."""
     from multihop_offload_tpu.cli.serve import build_service
+    from multihop_offload_tpu.loop.canary import CheckpointCanary
     from multihop_offload_tpu.loop.promote import PromotionController
     from multihop_offload_tpu.models import make_model
     from multihop_offload_tpu.obs import events as obs_events
@@ -370,6 +376,12 @@ def run_loop(cfg: Config, inject_regression: bool = False,
             cooldown_s=cfg.loop_cooldown_s,
         )
     champion_step = _bootstrap_champion(cfg, service)
+    # the semantic canary: golden probes recorded against the champion the
+    # cycle starts from; gates both promotion (controller.promote) and any
+    # later hot-reload the service performs (executor.canary)
+    canary = CheckpointCanary(service, pool, count=8, seed=cfg.seed + 1234)
+    canary.record_champion()
+    service.executor.canary = canary
     drift_monitor = None
     if getattr(cfg, "loop_drift", False):
         from multihop_offload_tpu.obs.drift import DriftMonitor
@@ -383,6 +395,11 @@ def run_loop(cfg: Config, inject_regression: bool = False,
     id_offset = (int(controller.ctx.get("id_offset", 0))
                  if resume_state else 0)
     for c in range(max(cfg.loop_cycles, 1)):
+        if drain is not None and drain.requested:
+            # orderly SIGTERM/SIGINT: the loop state is already journaled
+            # per transition — just stop opening new cycles
+            obs_events.emit("loop_drain", cycle=c, signum=drain.signum)
+            break
         wait = controller.cooldown_remaining()
         if wait > 0 and not resume_state:
             obs_events.emit("loop_cooldown_skip", cycle=c,
@@ -396,9 +413,14 @@ def run_loop(cfg: Config, inject_regression: bool = False,
             steady_after_validate=steady_after_validate and c == 0,
             drift_monitor=drift_monitor,
             resume_state=resume_state,
+            canary=canary,
         )
         resume_state = None
         cycles.append(rec)
+        # golden probes track the LIVE champion: after a cycle that moved
+        # weights (promotion or rollback), re-record so the next cycle's
+        # agreement gate measures against what is actually serving
+        canary.record_champion()
     return {
         "champion_bootstrap_step": champion_step,
         "cycles": cycles,
@@ -506,11 +528,17 @@ def main(argv=None):
     if cfg.loop_capture_sample <= 0.0:
         cfg = dataclasses.replace(cfg, loop_capture_sample=1.0)
         print("--loop_capture_sample unset; capturing every request")
+    from multihop_offload_tpu.utils.signals import GracefulDrain
+
+    drain = GracefulDrain().install()
     runlog = obs.start_run(cfg, role="loop")
     try:
-        out = run_loop(cfg)
+        out = run_loop(cfg, drain=drain)
     finally:
-        obs.finish_run(runlog)
+        # orderly drain seals the segment chain (terminal close): the next
+        # process starts a fresh segment, no crash rotate-aside
+        obs.finish_run(runlog, terminal=drain.requested)
+        drain.uninstall()
     if cfg.loop_out:
         write_record(out, cfg.loop_out)
     print(json.dumps(out, indent=2, default=str))

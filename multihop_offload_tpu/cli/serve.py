@@ -122,11 +122,15 @@ def main(argv=None):
     from multihop_offload_tpu.train.tb_logging import ScalarLogger
     from multihop_offload_tpu.utils.platform import apply_platform_env
 
+    from multihop_offload_tpu.obs import events as obs_events
+    from multihop_offload_tpu.utils.signals import GracefulDrain
+
     apply_platform_env()
     cfg = from_args(argv)
     runlog = obs.start_run(cfg, role="serve")
     service, pool = build_service(cfg)
     tb = ScalarLogger(cfg.tb_logdir or None)
+    drain = GracefulDrain().install()
 
     from multihop_offload_tpu.serve.workload import request_stream
 
@@ -138,24 +142,33 @@ def main(argv=None):
     )
     # closed loop: keep the queue full, tick, refill — every refused submit
     # is retried after the next tick (the demo has no other client to fail
-    # over to; a real deployment would shed instead)
+    # over to; a real deployment would shed instead).  SIGTERM/SIGINT stops
+    # the feed, finishes what was admitted, and closes the log terminally.
     pending = list(stream)
     pending.reverse()
     while pending or service.queue_depth:
+        if drain.requested:
+            break
         while pending:
             req = pending.pop()
             if not service.submit(req):
-                if service.buckets.bucket_for(*req.sizes) is not None:
-                    pending.append(req)   # backpressure: retry after the tick
-                break                     # too-large: dropped for good
+                if service.last_submit_outcome == "backpressure":
+                    pending.append(req)   # retryable: after the next tick
+                break          # too-large / invalid: dropped for good
         service.tick()
         # newly trained weights are picked up between ticks, not mid-batch
         service.hot_reload(cfg.model_dir())
         if tb.active:
             service.stats.log_tb(tb, service.stats.ticks, service.queue_depth)
+    if drain.requested:
+        # finish the in-flight work: everything already admitted is served
+        service.drain()
+        obs_events.emit("shutdown", reason="signal", signum=drain.signum,
+                        unserved=len(pending))
+    drain.uninstall()
     tb.flush()
     summary = service.stats.summary(wall_s=time.monotonic() - t0)
-    obs.finish_run(runlog)
+    obs.finish_run(runlog, terminal=drain.requested)
     print(json.dumps(summary, indent=2))
     return summary
 

@@ -1,0 +1,134 @@
+"""Semantic checkpoint canary: golden-probe decisions gate every swap.
+
+`train.checkpoints.tree_checksum` proves a candidate's BYTES are what was
+written; `serve.executor.param_signature` proves its SHAPES fit the live
+model.  Neither proves the weights *mean* anything — a bf16 refit that
+overflowed to NaN, or a scale-poisoned tree, is checksum-valid and
+signature-valid and would serve garbage.  The canary closes that hole
+semantically: a small frozen probe set (synthetic requests off the serving
+pool, packed ONCE into the service's own bucket layouts) is run through any
+candidate before it may replace the champion, and the candidate is refused
+when
+
+  * any live probe output (delay estimate / empirical score) is NaN/Inf, or
+  * its decisions (dst, is_local) agree with the champion's recorded golden
+    answers on less than `min_agreement` of probe jobs — the decision-
+    collapse signature of weight poisoning that finiteness alone misses.
+
+The probe programs are the executor's ALREADY-COMPILED per-bucket gnn
+programs (weights are arguments, shapes are the bucket pads), so a canary
+run costs a few dispatches and ZERO retraces.  Wired into `loop.promote`
+(journaled "canarying" state, opt-in kwarg) and `serve.executor.hot_reload`
+(pre-swap check via `executor.canary`); rejection means the champion simply
+keeps serving — it is not corruption, so nothing is quarantined.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from multihop_offload_tpu.serve.bucketing import pack_bucket
+from multihop_offload_tpu.serve.workload import request_stream
+
+# probe ids live far above any real traffic so trace/experience streams
+# can never collide with a client request id
+PROBE_ID_OFFSET = 900_000
+
+
+class CheckpointCanary:
+    """Frozen golden-probe gate bound to one service's compiled programs."""
+
+    def __init__(
+        self,
+        service,
+        pool: Sequence,
+        count: int = 8,
+        seed: int = 123,
+        min_agreement: float = 0.7,
+    ):
+        self.service = service
+        self.min_agreement = float(min_agreement)
+        self.golden: Optional[list] = None
+        # pack once: per-bucket (batch, keys, live-mask rows) in the exact
+        # layout the serving tick uses, so probe decisions and serving
+        # decisions are the same compiled math
+        self._batches = []
+        by_bucket: dict = {}
+        for req in request_stream(pool, count, seed=seed,
+                                  id_offset=PROBE_ID_OFFSET):
+            b = service.buckets.bucket_for(*req.sizes)
+            if b is not None and service.layout.sparse:
+                b = service._sparse_fit(req, b)
+            if b is None:
+                continue
+            by_bucket.setdefault(b, []).append(req)
+        if not by_bucket:
+            raise ValueError("no probe request fits any bucket")
+        hop_cache: dict = {}
+        for b, reqs in sorted(by_bucket.items()):
+            reqs = reqs[: service.slots]
+            pad = service.buckets[b]
+            binst, bjobs = pack_bucket(
+                reqs, pad, service.slots, dtype=service.dtype,
+                hop_cache=hop_cache, layout=service.layout,
+            )
+            keys = [service.request_key(r.request_id) for r in reqs]
+            while len(keys) < service.slots:
+                keys.append(keys[-1])
+            keys = np.stack([np.asarray(k) for k in keys])
+            # live (slot, job) entries: real request rows, true job counts
+            live = np.zeros((service.slots, pad.j), dtype=bool)
+            for i, r in enumerate(reqs):
+                live[i, : r.num_jobs] = True
+            self._batches.append((b, binst, bjobs, keys, live))
+
+    # ---- probe execution -------------------------------------------------
+
+    def _probe(self, variables) -> list:
+        """Run every probe batch through the executor's compiled gnn
+        programs with `variables`; host (dst, is_local, delay_est,
+        job_total, live) per batch."""
+        import jax
+
+        ex = self.service.executor
+        out_rows = []
+        for b, binst, bjobs, keys, live in self._batches:
+            gnn, _ = ex._steps[b]
+            out, _dev = gnn(variables, binst, bjobs, keys)
+            host = tuple(np.asarray(x) for x in jax.device_get(out))
+            out_rows.append((*host, live))
+        return out_rows
+
+    def record_champion(self) -> None:
+        """Snapshot the CURRENT champion's probe answers as the golden set."""
+        self.golden = [
+            (dst.copy(), is_local.copy())
+            for dst, is_local, _d, _t, _live in self._probe(
+                self.service.executor.variables)
+        ]
+
+    # ---- the gate --------------------------------------------------------
+
+    def check(self, candidate_variables) -> Optional[str]:
+        """None iff the candidate passes; else a typed refusal reason."""
+        rows = self._probe(candidate_variables)
+        for _dst, _is_local, delay_est, job_total, live in rows:
+            bad = (~np.isfinite(delay_est) | ~np.isfinite(job_total)) & live
+            if bool(bad.any()):
+                return "nonfinite_probe_outputs"
+        if self.golden is None:
+            return None  # no champion recorded yet: finiteness-only gate
+        agree = 0
+        total = 0
+        for (gdst, glocal), (dst, is_local, _d, _t, live) in zip(
+                self.golden, rows):
+            total += int(live.sum())
+            agree += int(((dst == gdst) & (is_local == glocal) & live).sum())
+        frac = agree / max(total, 1)
+        if frac < self.min_agreement:
+            # typed tag first (the counter label), detail after the colon
+            return (f"decision_collapse:agreement {frac:.3f} < "
+                    f"{self.min_agreement:g}")
+        return None

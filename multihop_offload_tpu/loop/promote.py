@@ -57,7 +57,7 @@ from multihop_offload_tpu.utils.durable import (
 JOURNAL_SCHEMA = 1
 
 STATES = (
-    "idle", "capturing", "refitting", "validating",
+    "idle", "capturing", "refitting", "validating", "canarying",
     "promoting", "promoted", "rejected", "monitoring",
     "rolling_back", "rolled_back",
 )
@@ -206,6 +206,7 @@ class PromotionController:
         candidate_step: Optional[int] = None,
         experience_ids: Optional[List[int]] = None,
         step: Optional[int] = None,
+        canary=None,
     ) -> Optional[int]:
         """Validated candidate -> serving tree -> hot-reload.
 
@@ -214,14 +215,30 @@ class PromotionController:
         verified checkpoint — so a crash anywhere in here resumes by
         calling `promote` again with `step=ctx["step"]` and lands in the
         same place.  Returns the serving step, or None when the candidate
-        was structurally rejected (wrong tree/shape/dtype signature — the
-        service keeps serving the champion untouched)."""
+        was structurally rejected (wrong tree/shape/dtype signature) or
+        semantically rejected (`canary`, a `loop.canary.CheckpointCanary`
+        — journaled "canarying" state) — either way the service keeps
+        serving the champion untouched."""
         live = service.executor.variables["params"]
         cand = candidate_variables["params"]
         if param_signature(cand) != param_signature(live):
             self.reject("param signature mismatch against live tree",
                         candidate_step=candidate_step)
             return None
+        if canary is not None:
+            # semantic gate BEFORE the write-ahead promoting intent: a
+            # refused candidate never pins a serving step
+            self.transition("canarying", candidate_step=candidate_step)
+            why = canary.check(candidate_variables)
+            if why is not None:
+                obs_registry().counter(
+                    "mho_canary_rejections_total",
+                    "candidate weight sets refused by the semantic canary",
+                ).inc(stage="promote", reason=why.split(":")[0])
+                obs_events.emit("canary_reject", stage="promote", reason=why,
+                                candidate_step=candidate_step)
+                self.reject(f"canary: {why}", candidate_step=candidate_step)
+                return None
         step = int(step) if step is not None else self._next_step()
         self.transition("promoting", step=step, candidate_step=candidate_step)
         faults.crashpoint("promote:pre_save")

@@ -95,10 +95,19 @@ def refit(
 
         grads, lc, lm = jax.vmap(one)(binst, bjobs, keys)
         g = jax.tree_util.tree_map(lambda x: jnp.mean(x, axis=0), grads)
-        updates, opt_state = optimizer.update(g, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        params = apply_max_norm_constraint(params, 1.0)
-        return params, opt_state, jnp.mean(lc), jnp.mean(lm)
+        # non-finite containment: one poisoned batch skips-and-counts the
+        # update in-jit — params AND optimizer state pass through untouched
+        ok = jnp.isfinite(jnp.mean(lc)) & jnp.isfinite(jnp.mean(lm))
+        for leaf in jax.tree_util.tree_leaves(g):
+            ok = ok & jnp.all(jnp.isfinite(leaf))
+        updates, opt_new = optimizer.update(g, opt_state, params)
+        p_new = optax.apply_updates(params, updates)
+        p_new = apply_max_norm_constraint(p_new, 1.0)
+        params = jax.tree_util.tree_map(
+            lambda new, old: jnp.where(ok, new, old), p_new, params)
+        opt_state = jax.tree_util.tree_map(
+            lambda new, old: jnp.where(ok, new, old), opt_new, opt_state)
+        return params, opt_state, jnp.mean(lc), jnp.mean(lm), ok
 
     # registered per-program cost attribution: the refit step AOT-compiles
     # on its first call and accounts each step's synced wall window
@@ -106,25 +115,34 @@ def refit(
 
     base_key = jax.random.PRNGKey(seed)
     losses = []
+    skipped = 0
     with span("loop/refit", steps=steps, batches=len(batches)):
         for s in range(steps):
             faults.crashpoint("refit:mid")
             binst, bjobs = batches[s % len(batches)]
             keys = jax.random.split(jax.random.fold_in(base_key, s), slots)
             t0 = time.perf_counter()  # nondet-ok(device-time accounting is a measurement)
-            params, opt_state, lc, lm = step_fn(
+            params, opt_state, lc, lm, ok = step_fn(
                 params, opt_state, binst, bjobs, keys
             )
             losses.append((float(lc), float(lm)))
-            # the float() pulls above are this loop's sync boundary
+            # the float() pulls above are this loop's sync boundary; the
+            # skip flag rides the same fetch
+            skipped += int(not bool(ok))
             step_fn.account(time.perf_counter() - t0)  # nondet-ok(same measurement)
     obs_registry().counter(
         "mho_loop_refit_steps_total", "experience fine-tuning steps run"
     ).inc(steps)
+    if skipped:
+        obs_registry().counter(
+            "mho_refit_skipped_updates_total",
+            "optimizer updates skipped on non-finite grads",
+        ).inc(skipped, phase="refit")
     info = {
         "steps": steps,
         "batches": len(batches),
         "outcomes": len(outcomes),
+        "skipped_updates": skipped,
         "loss_critic_first": losses[0][0],
         "loss_critic_last": losses[-1][0],
         "loss_mse_last": losses[-1][1],

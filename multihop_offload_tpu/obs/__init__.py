@@ -93,11 +93,14 @@ def start_run(cfg, role: str):
     return log
 
 
-def finish_run(log, registry_=None) -> None:
+def finish_run(log, registry_=None, terminal: bool = False) -> None:
     """Close an enabled run log: record device-memory gauges + a final
     watermark snapshot, append the summary event (phase-time table, full
     metric snapshot, per-program cost attribution), optionally dump the
-    Prometheus exposition, and detach the active-sink slot."""
+    Prometheus exposition, and detach the active-sink slot.
+    `terminal=True` (orderly shutdown — graceful drain) seals the active
+    segment into the rotated chain so a restarted process at the same
+    path needs no crash rotate-aside."""
     if log is None:
         return
     from multihop_offload_tpu.obs import jaxhooks
@@ -113,4 +116,4 @@ def finish_run(log, registry_=None) -> None:
             f.write(reg.prometheus_text())
     if get_run_log() is log:
         set_run_log(None)
-    log.close()
+    log.close(terminal=terminal)

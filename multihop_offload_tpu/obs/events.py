@@ -217,12 +217,22 @@ class RunLog:
             if not self._closed:
                 self._f.flush()
 
-    def close(self) -> None:
+    def close(self, terminal: bool = False) -> None:
+        """Flush and close the active segment.  `terminal=True` is the
+        orderly-shutdown contract (graceful drain): the active segment is
+        SEALED into the rotated chain (`path.NNNN`), leaving nothing at
+        `path` — so the next process at the same path starts a fresh
+        segment without the crash-restart rotate-aside, and readers
+        (`read_events` spans the chain) see a clean terminal segment ending
+        in this run's summary."""
         with self._lock:
             if not self._closed:
                 self._closed = True
                 self._f.flush()
                 self._f.close()
+                if terminal and os.path.exists(self.path):
+                    os.replace(self.path, f"{self.path}.{self._seq:04d}")
+                    self._seq += 1
 
 
 # ---- active-sink slot ------------------------------------------------------

@@ -140,6 +140,14 @@ def default_serving_slos(
             "jax_unexpected_retraces_total", objective=1.0,
             description="no recompiles after steady state",
         ),
+        # fed by the in-jit sentinel (`serve.executor.observe_decisions`):
+        # any live decision slot coming back NaN/Inf breaches immediately,
+        # and the breach callback snapshots the flight recorder
+        SLOSpec(
+            "serve_nonfinite", "counter_zero",
+            "mho_dev_serve_nonfinite_total", objective=1.0,
+            description="no non-finite decision outputs",
+        ),
     ]
     if mfu_floor > 0.0:
         specs.append(SLOSpec(

@@ -40,6 +40,7 @@ from multihop_offload_tpu.train import checkpoints as ckpt_lib
 DM_SERVE_DELAY_EST = "mho_dev_serve_delay_est"
 DM_SERVE_LOCAL = "mho_dev_serve_decisions_total{decision=local}"
 DM_SERVE_OFFLOAD = "mho_dev_serve_decisions_total{decision=offload}"
+DM_SERVE_NONFINITE = "mho_dev_serve_nonfinite_total"
 
 
 def serve_devmetrics():
@@ -53,6 +54,10 @@ def serve_devmetrics():
                    decision=decision)
     dm.histogram(DM_SERVE_DELAY_EST, tuple(10.0 ** e for e in range(-2, 5)),
                  "decision-time per-job delay estimate (decade buckets)")
+    # the in-jit non-finite sentinel: a live job slot whose delay estimate
+    # or empirical score is NaN/Inf — drives the `serve_nonfinite` SLO
+    dm.counter(DM_SERVE_NONFINITE,
+               "live decision outputs that were NaN/Inf, counted in-program")
     return dm.freeze()
 
 
@@ -62,13 +67,16 @@ def observe_decisions(dm, out, mask):
     identical facts.  `mask` keeps pad jobs out of every series."""
     import jax.numpy as jnp
 
-    _, is_local, delay_est, _ = out
+    _, is_local, delay_est, job_total = out
     live = mask
     dev = dm.init()
     dev = dm.inc(dev, DM_SERVE_LOCAL, is_local & live)
     dev = dm.inc(dev, DM_SERVE_OFFLOAD, (~is_local) & live)
     dev = dm.observe(dev, DM_SERVE_DELAY_EST, delay_est,
                      weights=live.astype(jnp.int32))
+    # non-finite sentinel: pad slots never count (their garbage is expected)
+    dev = dm.inc(dev, DM_SERVE_NONFINITE,
+                 (~jnp.isfinite(delay_est) | ~jnp.isfinite(job_total)) & live)
     return dev
 
 
@@ -115,6 +123,12 @@ class BucketExecutor:
         self.layout = resolve_layout(layout)
         self.devmetrics = serve_devmetrics()
         self.last_devmetrics: Optional[dict] = None
+        # semantic pre-swap gate (loop.canary.CheckpointCanary), attached by
+        # the loop runner; None = bytes+signature checks only.  Steps the
+        # canary has refused are cached so the latest-step poll doesn't
+        # re-restore and re-reject the same poisoned checkpoint every tick.
+        self.canary = None
+        self._canary_rejected: set = set()
         dm = self.devmetrics
         self._steps = {}
         self._closures = {}
@@ -231,10 +245,12 @@ class BucketExecutor:
         swap becomes a no-op instead of a crash or a silent corrupt load."""
         directory = os.path.join(model_dir, which)
         step = ckpt_lib.latest_step(directory)
-        if step is None or step == self.loaded_step:
+        if (step is None or step == self.loaded_step
+                or step in self._canary_rejected):
             return None
         restored, step = ckpt_lib.restore_verified(directory)
-        if restored is None or step == self.loaded_step:
+        if (restored is None or step == self.loaded_step
+                or step in self._canary_rejected):
             return None  # nothing verified newer: keep serving last-good
         cur = self.variables["params"]
 
@@ -251,7 +267,34 @@ class BucketExecutor:
         params = jax.tree_util.tree_map(
             lambda t, r: np.asarray(r, dtype=np.asarray(t).dtype), cur, rebuilt
         )
+        # semantic pre-swap gate: the checksum above proved the BYTES are
+        # what was written; nothing yet proved the WEIGHTS make sense.  A
+        # NaN/Inf leaf always refuses; the attached canary (when present)
+        # additionally probes decisions against the champion's golden
+        # answers.  Refusal is not corruption — the file is quarantine-free
+        # and the champion keeps serving.
+        why = None
+        if not all(bool(np.isfinite(np.asarray(x, dtype=np.float64)).all())
+                   for x in leaves):
+            why = "nonfinite_weights"
+        elif self.canary is not None:
+            why = self.canary.check({"params": params})
+        if why is not None:
+            self._canary_rejected.add(step)
+            self._canary_reject(step, why, stage="hot_reload")
+            return None
         self.variables = {"params": params}
         self.loaded_step = step
         self.loaded_lineage = ckpt_lib.load_lineage(directory, step)
         return step
+
+    def _canary_reject(self, step: int, why: str, stage: str) -> None:
+        """Account one semantic pre-swap refusal (counter + typed event)."""
+        from multihop_offload_tpu.obs import events as obs_events
+        from multihop_offload_tpu.obs.registry import registry as obs_registry
+
+        obs_registry().counter(
+            "mho_canary_rejections_total",
+            "candidate weight sets refused by the semantic canary",
+        ).inc(stage=stage, reason=why.split(":")[0])
+        obs_events.emit("canary_reject", step=step, stage=stage, reason=why)

@@ -43,6 +43,7 @@ class ServingStats:
     admitted: int = 0
     rejected: int = 0        # bounded-queue backpressure refusals
     too_large: int = 0       # no bucket fits — permanent refusal
+    invalid: int = 0         # admission-guard semantic refusals
     served: int = 0          # responses demuxed
     degraded: int = 0        # responses served by the analytic baseline
     decisions: int = 0       # real (unpadded) job decisions returned
@@ -57,7 +58,8 @@ class ServingStats:
 
     def record_submit(self, outcome: str, bucket: Optional[int] = None) -> None:
         """One admission decision: 'admitted', 'backpressure' (bounded-queue
-        refusal) or 'too_large' (no bucket fits).  `bucket` (known for both
+        refusal), 'too_large' (no bucket fits) or 'rejected_invalid'
+        (semantic guard refusal, `serve.guards`).  `bucket` (known for both
         admitted and backpressured requests) feeds the per-bucket OFFERED
         rate — the demand signal the placement planner and the loadgen's
         offered-vs-served block are built from."""
@@ -70,6 +72,8 @@ class ServingStats:
             self.rejected += 1
         elif outcome == "too_large":
             self.too_large += 1
+        elif outcome == "rejected_invalid":
+            self.invalid += 1
         else:
             raise ValueError(f"unknown submit outcome '{outcome}'")
         _registry().counter(
@@ -154,6 +158,7 @@ class ServingStats:
             "admitted": self.admitted,
             "rejected_backpressure": self.rejected,
             "rejected_too_large": self.too_large,
+            "rejected_invalid": self.invalid,
             "served": self.served,
             "degraded": self.degraded,
             "decisions": self.decisions,

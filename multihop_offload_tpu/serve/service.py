@@ -35,7 +35,11 @@ from multihop_offload_tpu.serve.bucketing import (
     pack_bucket,
     padding_waste,
 )
-from multihop_offload_tpu.serve.executor import BucketExecutor
+from multihop_offload_tpu.serve.executor import (
+    DM_SERVE_NONFINITE,
+    BucketExecutor,
+)
+from multihop_offload_tpu.serve.guards import validate_request
 from multihop_offload_tpu.serve.metrics import ServingStats
 from multihop_offload_tpu.serve.request import OffloadRequest, OffloadResponse
 from multihop_offload_tpu.utils.durable import with_backoff
@@ -144,6 +148,14 @@ class OffloadService:
         ]
         self._base_key = jax.random.PRNGKey(seed)
         self._hop_cache: dict = {}
+        # the last submit()'s admission verdict: "admitted" | "backpressure"
+        # | "too_large" | "rejected_invalid".  Closed-loop clients use it to
+        # tell a retryable refusal (backpressure) from a permanent one —
+        # re-submitting a guard-rejected request would loop forever.
+        self.last_submit_outcome: Optional[str] = None
+        # first-detection latch for the in-jit non-finite sentinel: the
+        # flight-recorder dump and typed event fire once per service life
+        self._nonfinite_seen = False
 
     # ---- admission ---------------------------------------------------------
 
@@ -152,20 +164,40 @@ class OffloadService:
         return sum(len(q) for q in self._queues)
 
     def submit(self, req: OffloadRequest, now: Optional[float] = None) -> bool:
-        """Admit a request, or refuse it (False) under backpressure / when no
-        bucket fits.  Refusal is the client's signal to retry elsewhere —
-        a bounded queue keeps the p99 of everything already admitted."""
+        """Admit a request, or refuse it (False) when semantically invalid
+        (`serve.guards`), under backpressure, or when no bucket fits.
+        `last_submit_outcome` says which; only backpressure is retryable.
+        A bounded queue keeps the p99 of everything already admitted."""
+        rej = validate_request(req)
+        if rej is not None:
+            # semantic refusal: typed reason, never enters a bucket
+            self.stats.record_submit("rejected_invalid")
+            self.last_submit_outcome = "rejected_invalid"
+            obs_registry().counter(
+                "mho_serve_rejected_total",
+                "requests refused by the admission guards, by reason",
+            ).inc(reason=rej.reason)
+            obs_events.emit(
+                "request_rejected", request_id=req.request_id,
+                reason=rej.reason, detail=rej.detail,
+            )
+            if self._tracing():
+                obs_trace.hop("reject", [req.request_id], reason=rej.reason)
+            return False
         b = self.buckets.bucket_for(*req.sizes)
         if b is not None and self.layout.sparse:
             b = self._sparse_fit(req, b)
         if b is None:
             self.stats.record_submit("too_large")
+            self.last_submit_outcome = "too_large"
             return False
         if self.queue_depth >= self.queue_cap:
             self.stats.record_submit("backpressure", bucket=b)
+            self.last_submit_outcome = "backpressure"
             return False
         self._queues[b].append((req, self.clock() if now is None else now))
         self.stats.record_submit("admitted", bucket=b)
+        self.last_submit_outcome = "admitted"
         self._arrivals[b] += 1
         obs_registry().gauge(
             "mho_serve_queue_depth", "pending admitted requests"
@@ -373,6 +405,7 @@ class OffloadService:
                     [max(t_done - t_enq, 0.0) for _, t_enq in taken],
                     shards=shards,
                 )
+                self._check_nonfinite(b, ids or [r.request_id for r in reqs])
         depth = self.queue_depth
         obs_registry().gauge(
             "mho_serve_queue_depth", "pending admitted requests"
@@ -392,6 +425,34 @@ class OffloadService:
         if self.slo is not None:
             self.slo.observe(self.clock() if now is None else now)
         return responses
+
+    def _check_nonfinite(self, bucket: int, request_ids: List[int]) -> None:
+        """First-detection hook for the in-jit non-finite sentinel.
+
+        The sentinel itself lives inside the compiled program (see
+        `executor.observe_decisions`) and costs nothing extra on the host —
+        the counter rides the batch's existing devmetrics flush.  Here we
+        only look at the already-fetched totals: on the FIRST non-zero
+        reading, emit a typed event and hand the flight recorder a
+        diagnostic row (the `serve_nonfinite` SLO breach then snapshots the
+        full ring via the health wiring in `cli.health`)."""
+        if self._nonfinite_seen:
+            return
+        dm = getattr(self.executor, "last_devmetrics", None) or {}
+        hits = sum(v for k, v in dm.items() if k.startswith(DM_SERVE_NONFINITE))
+        if not hits:
+            return
+        self._nonfinite_seen = True
+        obs_events.emit(
+            "nonfinite_detected", surface="serve", bucket=bucket,
+            count=int(hits), request_ids=request_ids,
+        )
+        if self.recorder is not None:
+            self.recorder.record(
+                "nonfinite", surface="serve", bucket=bucket,
+                count=int(hits), request_ids=request_ids,
+                tick=self.stats.ticks,
+            )
 
     def _capture_outcomes(self, reqs, batch_responses) -> None:
         """Emit sampled per-request "outcome" events (experience capture for

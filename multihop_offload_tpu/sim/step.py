@@ -67,6 +67,7 @@ DM_DROP_CAP = "mho_dev_sim_dropped_total{reason=capacity}"
 DM_FWD_LINK = "mho_dev_sim_forwarded_total{target=link}"
 DM_FWD_SERVER = "mho_dev_sim_forwarded_total{target=server}"
 DM_QUEUE_DEPTH = "mho_dev_sim_queue_depth"
+DM_NONFINITE = "mho_dev_sim_nonfinite_total"
 
 
 def sim_devmetrics(spec: SimSpec) -> DevMetrics:
@@ -83,6 +84,9 @@ def sim_devmetrics(spec: SimSpec) -> DevMetrics:
                    target=target)
     dm.histogram(DM_QUEUE_DEPTH, pow2_buckets(spec.cap),
                  "per-slot occupancy of every live queue (links + servers)")
+    dm.counter(DM_NONFINITE,
+               "per-stream non-finite sim accumulators/probabilities, "
+               "counted in-program per slot")
     return dm.freeze()
 
 
@@ -274,4 +278,9 @@ def sim_slot_step(
     dev = dm.inc(dev, DM_DROP_CAP, put & ~space_ok)
     dev = dm.inc(dev, DM_FWD_LINK, put_l & ~to_server)
     dev = dm.inc(dev, DM_FWD_SERVER, put_l & to_server)
+    # numeric sentinel: a poisoned rate/bandwidth that slipped past the
+    # admission guards shows up here as a non-finite arrival probability
+    # or delay accumulator — counted per stream per slot, zero in health
+    dev = dm.inc(dev, DM_NONFINITE,
+                 ~jnp.isfinite(gen_p) | ~jnp.isfinite(delay_sum))
     return new_state, sched, dev

@@ -229,7 +229,8 @@ class _Harness:
 
         from multihop_offload_tpu.agent.train_step import (
             DM_EPISODES, DM_GRAD_NORM, DM_LOSS_CRITIC_SQ, DM_LOSS_CRITIC_SUM,
-            DM_LOSS_MSE_SUM, episode_grad_norms, train_devmetrics,
+            DM_LOSS_MSE_SUM, DM_NONFINITE, episode_grad_norms,
+            train_devmetrics,
         )
 
         # declared once, before the first trace: the in-program loss-moment
@@ -271,6 +272,9 @@ class _Harness:
                          jnp.square(outs.loss_critic.astype(jnp.float32)))
             dev = dm.inc(dev, DM_LOSS_MSE_SUM, outs.loss_mse)
             dev = dm.inc(dev, DM_EPISODES, keys.shape[0])
+            dev = dm.inc(dev, DM_NONFINITE,
+                         ~jnp.isfinite(outs.loss_critic)
+                         | ~jnp.isfinite(outs.loss_mse))
             return (mem, outs.delays.job_total, outs.loss_critic,
                     outs.loss_mse, dev)
 
@@ -742,14 +746,24 @@ class Trainer(_Harness):
                     with span("train/replay", block=True):
                         self.key, k = jax.random.split(self.key)
                         tr0 = time.perf_counter()  # nondet-ok(device-time accounting is a measurement)
-                        params, self.opt_state, loss_dev = self._replay(
-                            self.memory, self.variables["params"],
-                            self.opt_state, key=k
-                        )
+                        params, self.opt_state, loss_dev, skipped_dev = \
+                            self._replay(
+                                self.memory, self.variables["params"],
+                                self.opt_state, key=k
+                            )
                         self.variables = {"params": params}
                         loss = float(loss_dev)
-                        # the float() pull is the sync boundary
+                        # the float() pull is the sync boundary (the skip
+                        # count below rides it — already host-resident)
+                        nskip = int(skipped_dev)
                         self._replay.account(time.perf_counter() - tr0)  # nondet-ok(same measurement)
+                    if nskip:
+                        # non-finite samples were contained in-jit: params
+                        # and optimizer state passed through untouched
+                        obs.registry().counter(
+                            "mho_refit_skipped_updates_total",
+                            "optimizer updates skipped on non-finite grads",
+                        ).inc(nskip, phase="replay")
                     self.replay_losses.append(loss)
                 losses.append(loss)
 

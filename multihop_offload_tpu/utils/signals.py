@@ -1,0 +1,62 @@
+"""Graceful SIGTERM/SIGINT drain for the long-running entry points.
+
+`mho-serve` and `mho-loop run` are the processes an operator (or a k8s pod
+eviction) stops with a signal.  Killing them mid-tick is survivable — the
+chaos drills prove crash-restart works — but an ORDERLY stop should not
+look like a crash: finish the in-flight tick, answer what was admitted,
+journal the loop state, and close the run-log segment cleanly (terminal
+close, `obs.events.RunLog.close(terminal=True)`), so the next process
+starts from a sealed segment chain instead of rotating a torn file aside.
+
+Stdlib-only; the handler just sets a flag — all drain work happens at the
+loop's own safe points, never inside a signal context.
+"""
+
+from __future__ import annotations
+
+import signal
+from typing import Optional, Tuple
+
+
+class GracefulDrain:
+    """Latches the first SIGTERM/SIGINT; the serving loop polls `requested`
+    at its safe points.  A second signal re-raises the default behaviour so
+    a stuck drain can still be killed interactively."""
+
+    def __init__(self, signals: Tuple[int, ...] = (signal.SIGTERM,
+                                                   signal.SIGINT)):
+        self.requested = False
+        self.signum: Optional[int] = None
+        self._previous = {}
+        self._signals = signals
+
+    def _handle(self, signum, frame):
+        if self.requested:
+            # second signal: restore defaults and let it take effect
+            self.uninstall()
+            signal.raise_signal(signum)
+            return
+        self.requested = True
+        self.signum = int(signum)
+
+    def install(self) -> "GracefulDrain":
+        for s in self._signals:
+            try:
+                self._previous[s] = signal.signal(s, self._handle)
+            except ValueError:
+                # not the main thread (tests, embedded use): poll-only mode
+                pass
+        return self
+
+    def uninstall(self) -> None:
+        for s, prev in self._previous.items():
+            try:
+                signal.signal(s, prev)
+            except ValueError:
+                pass
+        self._previous = {}
+
+    def request(self, signum: int = signal.SIGTERM) -> None:
+        """Programmatic drain request (tests, embedding loops)."""
+        self.requested = True
+        self.signum = int(signum)

@@ -1,4 +1,5 @@
-"""Seeded violation: JX006 (swallowed exceptions in a recovery-critical dir)."""
+"""Seeded violations: JX006 (swallowed exceptions in a recovery-critical
+dir) and JX008 (unguarded `1 - rho` saturation denominator)."""
 
 
 def resume_state(path):
@@ -18,3 +19,12 @@ def cleanup(path):
         os.remove(path)
     except:  # JX006: bare except swallows even KeyboardInterrupt
         pass
+
+
+def saturation_delay(rho):
+    # JX008: inf at rho=1, negative past it — must clamp, select, or waive
+    return 1.0 / (1 - rho)
+
+
+def saturation_delay_waived(rho):
+    return 1.0 / (1 - rho)  # div-ok(caller clamps rho to [0, 0.95])

@@ -250,8 +250,9 @@ def test_replay_buffer_and_optimizer(setup):
         mem = replay_remember(mem, out.grads["params"], out.loss_critic + i, out.loss_mse)
     assert int(mem.count) == 8 and int(mem.ptr) == 2
 
-    p2, s2, loss = replay_apply(mem, params, opt_state, opt, jax.random.PRNGKey(0), batch=4)
+    p2, s2, loss, skipped = replay_apply(mem, params, opt_state, opt, jax.random.PRNGKey(0), batch=4)
     assert np.isfinite(float(loss))
+    assert int(skipped) == 0
     d0 = np.asarray(params["cheb_0"]["kernel"])
     d1 = np.asarray(p2["cheb_0"]["kernel"])
     assert not np.allclose(d0, d1)

@@ -21,7 +21,8 @@ from multihop_offload_tpu.analysis.cli import main as lint_main
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SEEDED = os.path.join(REPO, "tests", "fixtures", "analysis_seeded")
 ALL_REPO_RULES = {"JX001", "JX002", "JX003", "JX004", "JX005", "JX006",
-                  "JX007", "MP001", "SL001", "OB001", "OB002", "OB003"}
+                  "JX007", "JX008", "MP001", "SL001", "OB001", "OB002",
+                  "OB003"}
 
 
 def run_on(tmp_path, files, select=None, baseline=None):
@@ -481,6 +482,49 @@ def test_jx007_alias_aware(tmp_path):
     """})
     jx = [f for f in rep.findings if f.rule == "JX007"]
     assert [f.line for f in jx] == [5, 8]
+
+
+def test_jx008_tp_waived_and_guarded_denominators(tmp_path):
+    rep = run_on(tmp_path, {"env/m.py": """\
+        import jax.numpy as jnp
+
+        def tp(x, rho):
+            return x / (1.0 - rho)
+
+        def tp_int_one(x, rho):
+            return x / (1 - rho)
+
+        def tp_nested(x, rho, c):
+            return x / ((1.0 - rho) * c)
+
+        def waived(x, rho):
+            return x / (1.0 - rho)  # div-ok(rho proven < 1 upstream)
+
+        def clamped(x, rho, eps):
+            return x / jnp.maximum(1.0 - rho, eps)
+
+        def selected(x, rho):
+            safe = jnp.where(rho < 1.0, 1.0 - rho, 1.0)
+            return x / safe
+
+        def other_sub(x, a, b):
+            return x / (a - b)  # not the 1-minus saturation shape
+    """})
+    jx = [f for f in rep.findings if f.rule == "JX008"]
+    assert [f.line for f in jx] == [4, 7, 10]
+    assert len([f for f in rep.waived if f.rule == "JX008"]) == 1
+
+
+def test_jx008_scoped_to_queueing_dirs(tmp_path):
+    src = """\
+        def tp(x, rho):
+            return x / (1.0 - rho)
+    """
+    rep = run_on(tmp_path, {"serve/m.py": src, "obs/m.py": src,
+                            "cli/m.py": src})
+    assert "JX008" not in rules_hit(rep)
+    rep = run_on(tmp_path, {"sim/m.py": src, "loop/m.py": src})
+    assert "JX008" in rules_hit(rep)
 
 
 # ---------------------------------------------------------------------------

@@ -187,6 +187,33 @@ def test_corrupt_checkpoint_quarantined_and_last_good_wins(tmp_path):
     assert obs_registry().counter("mho_ckpt_quarantined_total").total() >= 1
 
 
+def test_poison_checkpoint_is_checksum_valid_and_seeded(tmp_path):
+    """The semantic fault family's defining property: a weight-poisoned
+    checkpoint goes through the NORMAL save path, so integrity verification
+    passes — the corruption byte checks can never catch it."""
+    from multihop_offload_tpu.train import checkpoints as ckpt_lib
+
+    d = str(tmp_path / "orbax")
+    w = np.linspace(0.1, 1.6, 16, dtype=np.float32).reshape(4, 4)
+    ckpt_lib.save_checkpoint(d, 1, {"params": {"w": w}},
+                             lineage=ckpt_lib.make_lineage("offline"))
+    step = faults.poison_checkpoint(d, mode="nan", seed=3, fraction=0.25)
+    assert step == 2
+    assert ckpt_lib.has_verified(d, 2)  # checksum-VALID poison
+    restored, got = ckpt_lib.restore_verified(d)
+    assert got == 2
+    bad = np.asarray(restored["params"]["w"])
+    assert int(np.isnan(bad).sum()) == 4  # fraction of the 16 entries
+    np.testing.assert_array_equal(w[~np.isnan(bad)], bad[~np.isnan(bad)])
+    assert ckpt_lib.load_lineage(d, step=2)["source"] == "poison"
+    # determinism: the same seed poisons the same entries
+    again, _ = ckpt_lib.restore_verified(d)
+    np.testing.assert_array_equal(np.isnan(bad),
+                                  np.isnan(np.asarray(again["params"]["w"])))
+    with pytest.raises(ValueError, match="unknown poison mode"):
+        faults.poison_checkpoint(d, mode="zero")
+
+
 def test_gc_checkpoints_bounded_retention(tmp_path):
     from multihop_offload_tpu.train import checkpoints as ckpt_lib
 
@@ -298,3 +325,31 @@ def test_device_loss_drill_replaces_and_recovers(smoke):
     assert checks["decisions_never_wrong"], "golden decisions moved"
     assert checks["conservation"], "requests lost or duplicated"
     assert checks["fleet_restored"] and checks["served_after_restore"], rec
+
+
+def test_weight_poison_hot_reload_drill(smoke):
+    """Checksum-valid NaN poison at the hot-reload surface: both polls
+    refused (second proves the cached rejection), champion keeps serving,
+    nothing quarantined — refusal is semantic, not corruption."""
+    rec = smoke.run_weight_poison_hot_reload()
+    checks = rec["checks"]
+    assert checks["poison_passes_checksum"], "poison must be checksum-valid"
+    assert checks["reload_refused"], rec
+    assert checks["stayed_on_champion"], rec
+    assert checks["canary_reject_event"], "no canary_reject at stage hot_reload"
+    assert checks["no_quarantine"], "semantic refusal must not quarantine"
+    assert checks["still_gnn_on_champion"], rec
+
+
+def test_weight_poison_promotion_drill(smoke):
+    """The same fault class at the promotion surface: refused in the
+    journaled 'canarying' state BEFORE any write-ahead intent, with the
+    typed nonfinite reason, champion untouched."""
+    rec = smoke.run_weight_poison_promotion()
+    checks = rec["checks"]
+    assert checks["promotion_refused"], rec
+    assert checks["canarying_journaled"], rec
+    assert checks["no_serving_step_pinned"], rec
+    assert checks["canary_reject_event"], "no canary_reject at stage promote"
+    assert checks["typed_reason"], rec
+    assert checks["champion_still_serving"], rec

@@ -174,8 +174,10 @@ def test_default_serving_slos_cover_the_issue_set():
     specs = {s.name: s for s in default_serving_slos()}
     assert set(specs) == {
         "serve_p99", "serve_delivered", "serve_drops", "serve_queue",
-        "zero_unexpected_retraces",
+        "zero_unexpected_retraces", "serve_nonfinite",
     }
+    assert specs["serve_nonfinite"].kind == "counter_zero"
+    assert specs["serve_nonfinite"].metric == "mho_dev_serve_nonfinite_total"
     assert specs["serve_p99"].kind == "histogram_le"
     assert specs["serve_p99"].le == 0.25
     assert specs["zero_unexpected_retraces"].objective == 1.0

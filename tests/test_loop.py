@@ -279,6 +279,74 @@ def test_promotion_state_machine(tmp_path):
                       "rolling_back", "rolled_back"]
 
 
+def test_promotion_canary_refuses_poisoned_candidate(tmp_path):
+    """A NaN-poisoned candidate is signature-valid (same tree, same
+    shapes) and would be checksum-valid once saved — only the canary's
+    semantic probe can refuse it.  Refusal happens in the journaled
+    'canarying' state BEFORE the write-ahead 'promoting' intent, so no
+    poisoned step ever reaches the serving directory."""
+    from multihop_offload_tpu.loop.canary import CheckpointCanary
+
+    obs_registry().reset()
+    service, pool = _make_service()
+    model_dir = str(tmp_path / "model")
+    ctl = PromotionController(model_dir)
+    champion = jax.tree_util.tree_map(np.asarray,
+                                      service.executor.variables["params"])
+    ckpt_lib.save_checkpoint(
+        os.path.join(model_dir, "orbax"), 1, {"params": champion},
+        lineage=ckpt_lib.make_lineage("offline"),
+    )
+    assert service.hot_reload(model_dir) == 1
+    canary = CheckpointCanary(service, pool, count=6, seed=11)
+    canary.record_champion()
+
+    poisoned = jax.tree_util.tree_map(
+        lambda x: np.full_like(np.asarray(x), np.nan), champion)
+    got = ctl.promote(service, {"params": poisoned}, candidate_step=7,
+                      canary=canary)
+    assert got is None and ctl.state == "rejected"
+    assert service.executor.loaded_step == 1  # champion untouched
+    assert ckpt_lib.latest_step(ctl.directory) == 1  # nothing saved
+    states = [h["state"] for h in ctl.history]
+    assert states[:2] == ["canarying", "rejected"]
+    reg = obs_registry()
+    assert reg.counter("mho_canary_rejections_total").total(
+        stage="promote", reason="nonfinite_probe_outputs") == 1
+
+    # the same canary lets a semantically-sane candidate through
+    cand = jax.tree_util.tree_map(lambda x: np.asarray(x) + 1e-4, champion)
+    step = ctl.promote(service, {"params": cand}, candidate_step=8,
+                       canary=canary)
+    assert step == 2 and ctl.state == "promoted"
+    assert service.executor.loaded_step == 2
+
+
+def test_canary_decision_collapse_is_deterministic():
+    """The finite half of the gate: reversed-flat weights are finite
+    everywhere (no nonfinite refusal possible) but scramble the decision
+    head, so agreement against the recorded champion drops well below a
+    strict threshold — and the champion itself always passes."""
+    from multihop_offload_tpu.loop.canary import CheckpointCanary
+
+    service, pool = _make_service()
+    canary = CheckpointCanary(service, pool, count=6, seed=13,
+                              min_agreement=0.95)
+    assert canary.check(service.executor.variables) is None  # finiteness-only
+    canary.record_champion()
+    assert canary.check(service.executor.variables) is None  # self-agreement
+
+    scrambled = jax.tree_util.tree_map(
+        lambda x: np.ascontiguousarray(
+            np.asarray(x).reshape(-1)[::-1].reshape(np.shape(x))),
+        service.executor.variables,
+    )
+    why = canary.check(scrambled)
+    assert why is not None and why.startswith("decision_collapse:")
+    assert "agreement" in why and "< 0.95" in why
+    assert canary.check(scrambled) == why  # deterministic probe set
+
+
 def test_checkpoint_lineage_sidecar_round_trip(tmp_path):
     d = str(tmp_path / "orbax")
     params = {"params": {"w": np.ones((3,), np.float32)}}

@@ -373,3 +373,78 @@ def test_start_finish_run_wiring(tmp_path):
     assert "obs_smoke_total" in rows[-1]["metrics"]
     prom = open(cfg.obs_prom).read()
     assert "obs_smoke_total 1" in prom
+
+
+def test_graceful_drain_latches_and_polls():
+    import signal as _signal
+
+    from multihop_offload_tpu.utils.signals import GracefulDrain
+
+    drain = GracefulDrain(signals=(_signal.SIGUSR1,)).install()
+    try:
+        assert not drain.requested and drain.signum is None
+        _signal.raise_signal(_signal.SIGUSR1)
+        assert drain.requested and drain.signum == _signal.SIGUSR1
+    finally:
+        drain.uninstall()
+    # programmatic request (embedding loops, tests) takes the same path
+    d2 = GracefulDrain()
+    d2.request()
+    assert d2.requested and d2.signum == _signal.SIGTERM
+
+
+def test_terminal_close_seals_chain_next_run_needs_no_rotate_aside(tmp_path):
+    """The graceful-drain shutdown contract: `close(terminal=True)` seals
+    the active segment into the rotated chain, so a restarted process at
+    the SAME path opens a fresh segment without the crash rotate-aside —
+    and the spanning reader sees both runs, each a clean segment ending in
+    its own summary."""
+    from multihop_offload_tpu.obs.events import segment_paths
+
+    path = str(tmp_path / "run.jsonl")
+    log = RunLog(path, manifest={"event": "manifest", "ts": 0.0, "run": 1})
+    log.tick(n=1)
+    log.summary(metrics={})
+    log.close(terminal=True)
+    # sealed: nothing left at `path`; the segment lives in the chain
+    assert not os.path.exists(path)
+    assert [os.path.basename(p) for p in segment_paths(path)] == [
+        "run.jsonl.0000"]
+
+    log2 = RunLog(path, manifest={"event": "manifest", "ts": 1.0, "run": 2})
+    log2.tick(n=2)
+    log2.summary(metrics={})
+    log2.close(terminal=True)
+    assert [os.path.basename(p) for p in segment_paths(path)] == [
+        "run.jsonl.0000", "run.jsonl.0001"]
+
+    # spanning reader: both runs, in order, nothing duplicated by a
+    # rotate-aside (each segment starts with its own manifest)
+    rows = list(read_events(path))
+    assert [r["n"] for r in rows if r["event"] == "tick"] == [1, 2]
+    assert [r["run"] for r in rows if r["event"] == "manifest"] == [1, 2]
+    for seg in segment_paths(path):
+        seg_rows = [json.loads(line) for line in open(seg)]
+        assert seg_rows[0]["event"] == "manifest"
+        assert seg_rows[-1]["event"] == "summary"
+
+    # double-close stays idempotent and never invents a new segment
+    log2.close(terminal=True)
+    assert len(segment_paths(path)) == 2
+
+
+def test_finish_run_terminal_routes_the_drain_contract(tmp_path):
+    """`obs.finish_run(log, terminal=True)` — what mho-serve/mho-loop call
+    on an orderly drain — appends the summary and seals the segment."""
+    import types
+
+    from multihop_offload_tpu import obs
+
+    cfg = types.SimpleNamespace(obs_log=str(tmp_path / "run.jsonl"))
+    log = obs.start_run(cfg, role="drain")
+    obs_events.emit("shutdown", reason="signal", signum=15)
+    obs.finish_run(log, terminal=True)
+    assert not os.path.exists(cfg.obs_log)  # sealed, not left behind
+    rows = list(read_events(cfg.obs_log))
+    assert rows[-1]["event"] == "summary"
+    assert any(r["event"] == "shutdown" and r["signum"] == 15 for r in rows)

@@ -116,7 +116,7 @@ def test_replay_training_loop_runs(world):
         losses.append(np.mean(round_losses))
         if count >= cfg.batch:
             key, k = jax.random.split(key)
-            params, opt_state, _ = replay_apply(
+            params, opt_state, _, _ = replay_apply(
                 mem, variables["params"], opt_state, opt, k, batch=cfg.batch
             )
             variables = {"params": params}
