@@ -15,6 +15,10 @@ JX008 saturation div    unguarded `x / (1 - ...)` in the queueing-math
                         dirs — the M/M/1 utilization denominator blows
                         up to inf/NaN exactly at the saturated inputs
                         the admission guards exist to keep out
+JX009 rollout purity    host sync / callback (`.item()`, `np.*`,
+                        `jax.debug.callback` / `io_callback`) inside an
+                        rl/ rollout-scan body — the Anakin closed loop
+                        must stay one compiled program
 
 JX001 runs a small intraprocedural taint pass over each jit-reachable
 function (see `reachability`): values produced by `jax.*` calls are
@@ -567,3 +571,81 @@ def check_jx007(mod: ModuleCtx) -> Iterator[Finding]:
                      "'# placement-ok(<why>)'"),
             snippet=_snippet(mod, node),
         )
+
+
+# ---------------------------------------------------------------------------
+# JX009 — host sync / callback inside an rl/ rollout-scan body
+# ---------------------------------------------------------------------------
+
+_JX009_CALLBACKS = {
+    "jax.debug.print", "jax.debug.callback",
+    "jax.experimental.io_callback", "jax.io_callback",
+}
+
+
+def _jx009_scan_bodies(mod: ModuleCtx):
+    """AST subtrees passed as the body callable of a `jax.lax.scan` call:
+    lambdas inline, plus every module-level/nested `def` whose name is the
+    first scan argument (one def may back several scans — yielded once)."""
+    fns: dict = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fns.setdefault(node.name, []).append(node)
+    seen = set()
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        canon = mod.canonical(node.func) if isinstance(
+            node.func, (ast.Name, ast.Attribute)) else None
+        if canon != "jax.lax.scan":
+            continue
+        body = node.args[0]
+        if isinstance(body, ast.Lambda):
+            yield body
+        elif isinstance(body, ast.Name):
+            for fn in fns.get(body.id, []):
+                if id(fn) not in seen:
+                    seen.add(id(fn))
+                    yield fn
+
+
+@rule(
+    id="JX009", severity="error",
+    scope="rl/",
+    waiver="# rollout-ok(",
+    doc=("host sync or callback (`.item()`, `np.*`, `jax.debug.callback` / "
+         "`io_callback`) inside an rl/ rollout-scan body — the Anakin "
+         "contract is ONE compiled program between episodes; any host hop "
+         "in the scan serializes the device at every round"),
+    dirs=("rl",),
+)
+def check_jx009(mod: ModuleCtx) -> Iterator[Finding]:
+    emitted = set()
+    for body in _jx009_scan_bodies(mod):
+        for node in ast.walk(body):
+            if not isinstance(node, ast.Call):
+                continue
+            msg = None
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "item" and not node.args):
+                msg = (".item() inside a rollout scan body forces a "
+                       "device->host sync every iteration")
+            else:
+                canon = mod.canonical(node.func) if isinstance(
+                    node.func, (ast.Name, ast.Attribute)) else None
+                if canon in _JX009_CALLBACKS:
+                    msg = (f"{canon} inside a rollout scan body round-trips "
+                           "the host from inside the compiled loop")
+                elif canon == "numpy" or (canon or "").startswith("numpy."):
+                    msg = (f"{canon} inside a rollout scan body is host "
+                           "numpy — the result is computed outside the "
+                           "program and re-transferred every iteration")
+            if msg is None or (node.lineno, msg) in emitted:
+                continue
+            emitted.add((node.lineno, msg))
+            yield Finding(
+                rule="JX009", path=mod.path, line=node.lineno,
+                message=(msg + " — keep the body device-native (jnp/lax), "
+                         "or waive with '# rollout-ok(<why>)'"),
+                snippet=_snippet(mod, node),
+            )

@@ -230,6 +230,34 @@ class Config:
     sim_fail_nodes: int = 0        # random non-server nodes to fail likewise
     sim_out: str = ""              # write the run/fidelity JSON record here
     #                                ("" = print only / default record path)
+    # ---- on-device RL (rl/ subsystem; cli.rl) ------------------------------
+    rl_steps: int = 30             # compiled train steps per `mho-rl train`
+    rl_fleet: int = 4              # episodes (instances) per train step —
+    #                                the vmapped/sharded batch axis
+    rl_rounds: int = 3             # policy re-decisions per episode (the
+    #                                rollout's outer scan; scenario shape
+    #                                comes from the sim_* knobs)
+    rl_slots: int = 120            # sim slots per policy round (inner scan)
+    rl_temp: float = 0.5           # categorical temperature over the offload
+    #                                cost table (higher = more exploration)
+    rl_delay_weight: float = 0.05  # reward = delivered_ratio - weight *
+    #                                mean delivered delay (model-time units)
+    rl_ent: float = 0.05           # entropy-bonus weight in the surrogate
+    #                                loss (guards against premature
+    #                                deterministic collapse of REINFORCE)
+    rl_buffer: int = 64            # on-device reward ring capacity backing
+    #                                the REINFORCE running-mean baseline
+    rl_util: float = 0.7           # analytic bottleneck-utilization target
+    #                                (rho) the RL scenarios are rescaled to
+    rl_lr: float = 2e-3            # Adam learning rate for the in-scan
+    #                                update (the offline `learning_rate` is
+    #                                tuned for file visits, not episodes)
+    rl_mesh: int = 1               # fleet-batch mesh axis size: 1 = single
+    #                                device, N = shard_map the fleet over N
+    #                                devices (grads pmean'd in-program)
+    rl_out: str = ""               # write the smoke/train JSON record here
+    #                                ("" = benchmarks/rl_smoke.json in
+    #                                --smoke mode, print only otherwise)
     # ---- observability (obs/ subsystem; docs/OPERATIONS.md) ----------------
     obs_log: str = ""              # structured JSONL run-log sink ("" =
     #                                disabled): manifest header + typed

@@ -1,0 +1,48 @@
+"""On-device closed-loop RL: actor + FleetSim + learner, one program.
+
+The Anakin/Podracer subsystem (ROADMAP "closed-loop training"): rollouts
+are `lax.scan`s over policy rounds with the packet simulator scanned
+inside each round, vmapped over a fleet of instances, differentiated with
+REINFORCE and updated with the repo's Keras-parity Adam — all inside ONE
+jitted train step.  See `rl.rollout` for the episode tape, `rl.buffer`
+for the on-device baseline memory and `rl.trainer` for the compiled step,
+sharding, telemetry and checkpoint interop.
+"""
+
+from multihop_offload_tpu.rl.buffer import (
+    RLBuffer,
+    buffer_baseline,
+    buffer_init,
+    buffer_push,
+)
+from multihop_offload_tpu.rl.rollout import (
+    RolloutOut,
+    RoundDeltas,
+    reward_from_deltas,
+    rollout,
+    sample_offloads,
+)
+from multihop_offload_tpu.rl.trainer import (
+    RLStepOut,
+    RLTrainer,
+    delivered_ratio,
+    make_eval,
+    rl_devmetrics,
+)
+
+__all__ = [
+    "RLBuffer",
+    "RLStepOut",
+    "RLTrainer",
+    "RolloutOut",
+    "RoundDeltas",
+    "buffer_baseline",
+    "buffer_init",
+    "buffer_push",
+    "delivered_ratio",
+    "make_eval",
+    "reward_from_deltas",
+    "rl_devmetrics",
+    "rollout",
+    "sample_offloads",
+]

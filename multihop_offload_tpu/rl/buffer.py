@@ -1,0 +1,58 @@
+"""On-device replay/advantage buffer: a pytree ring of recent rewards.
+
+The REINFORCE baseline is the running mean of recently observed rewards.
+Keeping that memory ON the device as a scan-friendly pytree (same idiom
+as `agent.replay.GradReplay`) lets the whole train step stay one compiled
+program: the buffer rides the step's carry, the baseline is computed with
+pure `jnp` ops, and nothing syncs to host between episodes.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import struct
+from jax import lax
+
+
+@struct.dataclass
+class RLBuffer:
+    rewards: jnp.ndarray   # (capacity,) fp32 ring of recent round rewards
+    count: jnp.ndarray     # () int32 filled slots
+    ptr: jnp.ndarray       # () int32 next write position
+
+
+def buffer_init(capacity: int) -> RLBuffer:
+    return RLBuffer(
+        rewards=jnp.zeros((capacity,), jnp.float32),  # reward statistics accumulate wide by design
+        count=jnp.zeros((), jnp.int32),
+        ptr=jnp.zeros((), jnp.int32),
+    )
+
+
+def buffer_push(buf: RLBuffer, values: jnp.ndarray) -> RLBuffer:
+    """Append every element of `values` (deque(maxlen=capacity) semantics,
+    oldest evicted first).  Pure and jittable — one tiny scan."""
+    capacity = buf.rewards.shape[0]
+
+    def push_one(b, v):
+        return RLBuffer(
+            rewards=b.rewards.at[b.ptr].set(v.astype(b.rewards.dtype)),
+            count=jnp.minimum(b.count + 1, capacity),
+            ptr=(b.ptr + 1) % capacity,
+        ), None
+
+    buf, _ = lax.scan(push_one, buf, jnp.ravel(values))
+    return buf
+
+
+def buffer_baseline(buf: RLBuffer) -> jnp.ndarray:
+    """Mean of the filled slots; 0 while empty (the first episodes train
+    against a zero baseline, exactly REINFORCE without a critic)."""
+    capacity = buf.rewards.shape[0]
+    filled = jnp.arange(capacity, dtype=jnp.int32) < buf.count
+    total = jnp.sum(jnp.where(filled, buf.rewards, 0.0))
+    return jnp.where(
+        buf.count > 0,
+        total / jnp.maximum(buf.count, 1).astype(buf.rewards.dtype),
+        jnp.zeros((), buf.rewards.dtype),
+    )

@@ -1,0 +1,294 @@
+"""Differentiable closed-loop rollout: actor + FleetSim in one scan.
+
+The Anakin/Podracer recipe ("Podracer architectures for scalable
+Reinforcement Learning", PAPERS.md) colocates actor, environment and
+learner in a single compiled program.  This module is the actor+env half:
+one `lax.scan` over policy rounds, each round re-deciding offloads from
+the *in-scan empirical arrival rates* (the same measured-traffic contract
+as `sim.runner.simulate`) and then advancing the packet simulator through
+an inner slot scan — no host transfer anywhere.
+
+Differentiability: the simulator is discrete (integer ring buffers and
+counters), so the policy gradient is score-function (REINFORCE), not
+pathwise.  Each round the actor's unit delays price a `(J, S+1)` offload
+cost table (`env.offloading.offload_decide` — the exact decision
+machinery the analytic trainer and the sim policies share); the table
+becomes a temperature-scaled categorical over destinations, a destination
+is *sampled*, and the round's log-probability is kept.  Rewards come from
+the `SimState` conservation counters the inner scan already maintains
+(delivered-ratio minus a normalized delay penalty, both per round), and
+the surrogate loss is
+
+    loss = - sum_r  logp_r * stop_gradient(reward_r - baseline)
+
+so gradients flow ONLY through the log-probabilities — through the cost
+table, the APSP, the interference fixed point and the GNN — never through
+the simulator dynamics.  Sampled routes enter the sim as integer arrays
+(no tangents), keeping the scan carry gradient-free by construction.
+
+Sparse-native: `layout` resolves exactly as in `sim.policies.decide_routes`
+— edge-list weight matrices, step-form unit delays and compact int16
+forwarding tables under the sparse layout, dense (N, N) math otherwise.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+from jax import lax
+
+from multihop_offload_tpu.agent.actor import actor_delay_matrix, default_support
+from multihop_offload_tpu.env.apsp import (
+    apsp_minplus,
+    next_hop_table,
+    weight_matrix_from_link_delays,
+)
+from multihop_offload_tpu.env.offloading import offload_decide
+from multihop_offload_tpu.graphs.instance import Instance, JobSet
+from multihop_offload_tpu.layouts import (
+    next_hop_from_edges,
+    pack_next_hop,
+    resolve_layout,
+    weight_matrix_from_edges,
+)
+from multihop_offload_tpu.sim.state import (
+    SimParams,
+    SimRoutes,
+    SimSpec,
+    SimState,
+    liveness_masks,
+)
+from multihop_offload_tpu.sim.step import sim_slot_step
+
+
+@struct.dataclass
+class RoundDeltas:
+    """Per-round counter deltas (stacked (R,) after the scan) — the exact
+    integers the reward is computed from, exposed so tests can recompute
+    the reward math on host bit for bit."""
+
+    generated: jnp.ndarray   # () int32 packets born this round
+    delivered: jnp.ndarray   # () int32 packets delivered this round
+    dropped: jnp.ndarray     # () int32 packets lost this round
+    delay_sum: jnp.ndarray   # () float end-to-end slots summed this round
+
+
+@struct.dataclass
+class RolloutOut:
+    """Everything one episode returns besides the surrogate loss."""
+
+    state: SimState          # terminal sim state, counters cumulative
+    rewards: jnp.ndarray     # (R,) per-round rewards (stop-gradient values)
+    logps: jnp.ndarray       # (R,) per-round summed action log-probs
+    ents: jnp.ndarray        # (R,) per-round summed policy entropies
+    deltas: RoundDeltas      # (R,)-stacked counter deltas behind `rewards`
+    dsts: jnp.ndarray        # (R, J) int32 sampled destinations per round
+    routes: SimRoutes        # (R,)-stacked forwarding decisions in force
+    dev: Any = ()            # sim devmetrics accumulators for the episode
+
+
+def reward_from_deltas(gen_d, del_d, delay_d, dt, delay_weight):
+    """The reward spec, shared verbatim with the host-side test oracle:
+    delivered ratio minus `delay_weight` times the mean delivered-packet
+    delay in model-time units.  All inputs are this round's counter
+    deltas; denominators clamp at one packet so idle rounds score zero."""
+    fdt = jnp.asarray(delay_d).dtype
+    gen = jnp.asarray(gen_d).astype(fdt)
+    dlv = jnp.asarray(del_d).astype(fdt)
+    ratio = dlv / jnp.maximum(gen, 1.0)
+    mean_delay = jnp.asarray(delay_d) * jnp.asarray(dt).astype(fdt) \
+        / jnp.maximum(dlv, 1.0)
+    return ratio - delay_weight * mean_delay
+
+
+def sample_offloads(
+    model,
+    variables,
+    inst: Instance,
+    jobs_est: JobSet,
+    support,
+    node_up: jnp.ndarray,
+    link_up: jnp.ndarray,
+    key: jax.Array,
+    temperature: float,
+    fp_fn=None,
+    apsp_fn=None,
+    layout=None,
+):
+    """One differentiable policy decision: (routes, logp, choice).
+
+    The actor forward, APSP and cost table stay on the gradient tape (the
+    log-probability is differentiated through them); the forwarding table
+    and the sampled destination are built on stopped values — they enter
+    the simulator as integers and never need tangents.
+    """
+    lay = resolve_layout(layout)
+    actor = actor_delay_matrix(
+        model, variables, inst, jobs_est, support, fp_fn=fp_fn, layout=lay
+    )
+    if lay.sparse:
+        unit_diag = jnp.where(inst.comp_mask, actor.node_delay, jnp.inf)
+    else:
+        unit_diag = jnp.diagonal(actor.delay_matrix)
+    inf = jnp.inf
+    link_delay = jnp.where(link_up, actor.link_delay, inf)
+    unit_diag = jnp.where(node_up, unit_diag, inf)
+    if lay.sparse:
+        w = weight_matrix_from_edges(
+            inst.link_ends, inst.link_mask, link_delay, inst.num_pad_nodes
+        )
+    else:
+        w = weight_matrix_from_link_delays(
+            inst.adj, inst.link_index, link_delay
+        )
+    # static squaring schedule (early_stop=False): the while_loop early
+    # exit is not reverse-differentiable, and HERE the APSP is on-tape —
+    # the log-prob differentiates through path costs (same distances)
+    apsp = apsp_fn or (lambda m: apsp_minplus(m, early_stop=False))
+    sp = apsp(w)
+    # the shared decision skeleton prices every (job, server|local) option;
+    # its argmin/explore sampling is ignored — the RL policy samples its own
+    # temperature-scaled categorical so the log-prob stays differentiable
+    dec = offload_decide(
+        inst, jobs_est, sp, inst.hop, unit_diag, key, 0.0, False
+    )
+    valid = jnp.isfinite(dec.costs)
+    logits = jnp.where(valid, -dec.costs / temperature, -inf)
+    k_act, _ = jax.random.split(key)
+    choice = jax.random.categorical(k_act, logits, axis=1)       # (J,)
+    logp_all = jax.nn.log_softmax(logits, axis=1)
+    logp_j = jnp.take_along_axis(logp_all, choice[:, None], axis=1)[:, 0]
+    logp = jnp.sum(jnp.where(jobs_est.mask, logp_j, 0.0))
+    # policy entropy (invalid options carry p=0 exactly): the trainer's
+    # entropy bonus works against premature collapse — REINFORCE with
+    # all-positive rewards otherwise reinforces itself deterministic
+    # mask BEFORE the product: p * logp at an invalid entry is 0 * -inf
+    # (NaN), and a forward NaN — even a where-masked one — poisons the
+    # backward pass (0 cotangent * NaN = NaN) and would void every update
+    safe_logp = jnp.where(valid, logp_all, 0.0)
+    ent_j = -jnp.sum(jnp.exp(safe_logp) * safe_logp * valid, axis=1)
+    entropy = jnp.sum(jnp.where(jobs_est.mask, ent_j, 0.0))
+
+    servers = inst.servers
+    num_srv = servers.shape[0]
+    is_local = choice >= num_srv
+    src = jobs_est.src.astype(jnp.int32)
+    dst = jnp.where(
+        is_local, src,
+        servers[jnp.clip(choice, 0, num_srv - 1)].astype(jnp.int32),
+    )
+    sp_s = lax.stop_gradient(sp)
+    # a destination unreachable from the source degrades to local compute —
+    # same packet-safety contract as `sim.policies.decide_routes` (sampling
+    # can't pick it: its cost is +inf, but the guard keeps the invariant)
+    reachable = jnp.isfinite(sp_s[src, dst]) & node_up[dst]
+    dst = jnp.where(reachable, dst, src)
+    nh = (next_hop_from_edges(inst.link_ends, inst.link_mask, sp_s)
+          if lay.sparse else next_hop_table(inst.adj, sp_s))
+    routes = SimRoutes(
+        dst=dst.astype(jnp.int32),
+        next_hop=pack_next_hop(nh),
+        reach=jnp.isfinite(sp_s),
+    )
+    return routes, logp, entropy, choice.astype(jnp.int32)
+
+
+def rollout(
+    model,
+    variables,
+    inst: Instance,
+    jobs: JobSet,
+    spec: SimSpec,
+    params: SimParams,
+    state0: SimState,
+    init_rates: jnp.ndarray,
+    key: jax.Array,
+    baseline,
+    rounds: int,
+    slots_per_round: int,
+    temperature: float = 1.0,
+    delay_weight: float = 0.05,
+    ent_weight: float = 0.0,
+    support=None,
+    dm=None,
+    fp_fn=None,
+    apsp_fn=None,
+    layout=None,
+):
+    """One on-device episode (pure, jittable, vmappable over the fleet).
+
+    Returns `(loss, RolloutOut)` where `loss` is the REINFORCE surrogate
+    against `baseline` (a scalar, typically the replay buffer's running
+    reward mean).  Round 0 decides on `init_rates`; later rounds on the
+    previous round's measured arrival rates — identical windowing to
+    `sim.runner.simulate`, so the closed loop the learner trains in is the
+    closed loop the evaluator measures.
+    """
+    lay = resolve_layout(layout)
+    if support is None:
+        support = default_support(model, inst, layout=lay)
+    j = spec.num_jobs
+    fdt = state0.delay_sum.dtype
+
+    def round_body(carry, xs):
+        st, dev, prev_gen = carry
+        kr, is_first = xs
+        k_dec, k_slots = jax.random.split(kr)
+        node_up, link_up = liveness_masks(inst, params, st.t)
+        window = (st.generated - prev_gen)[:j].astype(fdt)
+        denom = (
+            slots_per_round * params.dt.astype(fdt)
+            * jnp.maximum(jobs.ul.astype(fdt), 1e-9)
+        )
+        est = jnp.where(is_first, init_rates.astype(fdt), window / denom)
+        jobs_est = jobs.replace(rate=est.astype(jobs.rate.dtype))
+        routes, logp, ent, _ = sample_offloads(
+            model, variables, inst, jobs_est, support, node_up, link_up,
+            k_dec, temperature, fp_fn=fp_fn, apsp_fn=apsp_fn, layout=lay,
+        )
+
+        def slot_body(c, kk):
+            s, d = c
+            if dm is None:
+                s2, _ = sim_slot_step(
+                    inst, spec, params, routes, jobs, s, kk
+                )
+            else:
+                s2, _, d = sim_slot_step(
+                    inst, spec, params, routes, jobs, s, kk, dm=dm, dev=d
+                )
+            return (s2, d), None
+
+        (st2, dev2), _ = lax.scan(
+            slot_body, (st, dev), jax.random.split(k_slots, slots_per_round)
+        )
+        deltas = RoundDeltas(
+            generated=jnp.sum(st2.generated - st.generated),
+            delivered=jnp.sum(st2.delivered - st.delivered),
+            dropped=jnp.sum(st2.dropped - st.dropped),
+            delay_sum=jnp.sum(st2.delay_sum - st.delay_sum),
+        )
+        reward = lax.stop_gradient(reward_from_deltas(
+            deltas.generated, deltas.delivered, deltas.delay_sum,
+            params.dt, delay_weight,
+        ))
+        return (st2, dev2, st.generated), (logp, ent, reward, deltas,
+                                           routes.dst, routes)
+
+    xs = (
+        jax.random.split(key, rounds),
+        jnp.arange(rounds, dtype=jnp.int32) == 0,
+    )
+    dev0 = dm.init() if dm is not None else ()
+    (st_f, dev_f, _), (logps, ents, rewards, deltas, dsts, routes) = \
+        lax.scan(round_body, (state0, dev0, state0.generated), xs)
+    adv = rewards - jnp.asarray(baseline).astype(rewards.dtype)
+    loss = (-jnp.sum(logps * lax.stop_gradient(adv))
+            - ent_weight * jnp.sum(ents))
+    return loss, RolloutOut(
+        state=st_f, rewards=rewards, logps=logps, ents=ents, deltas=deltas,
+        dsts=dsts, routes=routes, dev=dev_f,
+    )

@@ -1,0 +1,383 @@
+"""Anakin-style on-device closed-loop trainer: one compiled train step.
+
+One `RLTrainer` step = rollout + learn in a SINGLE jitted program:
+
+    [optional shard_map over the mesh "data" axis]
+      vmap over fleet lanes
+        lax.scan over policy rounds            # rl/rollout.py
+          sample offloads (GNN actor, on-tape)
+          lax.scan over sim slots              # sim/step.py
+      per-lane REINFORCE grads  ->  mean (pmean across shards)
+    non-finite skip-and-count  ->  Adam + max-norm  ->  buffer push
+
+Nothing leaves the device between the episode and the update — the
+Podracer/Anakin colocation the ROADMAP names.  The optimizer is the
+repo's optimizer of record (`agent.replay.make_optimizer`: Keras-parity
+Adam with per-leaf clipnorm and the post-update max-norm constraint), and
+the non-finite containment mirrors `agent.replay.replay_apply`: a step
+whose mean gradient carries NaN/Inf leaves params AND Adam moments
+untouched, counted in-program and surfaced through the registry as
+`mho_refit_skipped_updates_total{phase=rl}`.
+
+Telemetry rides devmetrics (free in-scan accounting): the sim's
+conservation counters thread through the rollout scan, and an RL window
+(episodes, reward moments, per-episode grad-norm decade histogram,
+non-finite sentinel, skipped updates) accumulates per step — both flushed
+at the step's existing sync boundary.  The compiled step registers with
+`obs.prof` under ``rl/train_step`` for live MFU/HBM accounting.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import struct
+from jax.sharding import PartitionSpec
+
+from multihop_offload_tpu.agent.replay import (
+    apply_max_norm_constraint,
+    make_optimizer,
+)
+from multihop_offload_tpu.agent.train_step import episode_grad_norms
+from multihop_offload_tpu.config import Config
+from multihop_offload_tpu.graphs.instance import Instance, JobSet
+from multihop_offload_tpu.obs import jaxhooks
+from multihop_offload_tpu.obs import prof as obs_prof
+from multihop_offload_tpu.obs.registry import registry
+from multihop_offload_tpu.obs.spans import span
+from multihop_offload_tpu.parallel.compat import shard_map
+from multihop_offload_tpu.rl.buffer import (
+    buffer_baseline,
+    buffer_init,
+    buffer_push,
+)
+from multihop_offload_tpu.rl.rollout import RoundDeltas, rollout
+from multihop_offload_tpu.sim.state import SimSpec, SimState, init_state
+from multihop_offload_tpu.sim.step import sim_devmetrics
+
+# ---- device metrics for the RL hot loop ---------------------------------
+# One window per train step, flushed at the step's sync boundary.  The
+# skipped-updates counter deliberately reuses the refit series name with a
+# phase label, so one dashboard tracks non-finite containment across the
+# offline, refit and rl trainers.
+
+DM_RL_EPISODES = "mho_dev_rl_episodes_total"
+DM_RL_ROUNDS = "mho_dev_rl_rounds_total"
+DM_RL_REWARD_SUM = "mho_dev_rl_reward_sum"
+DM_RL_REWARD_SQ = "mho_dev_rl_reward_sq_sum"
+DM_RL_GRAD_NORM = "mho_dev_rl_grad_norm"
+DM_RL_NONFINITE = "mho_dev_rl_nonfinite_total"
+DM_RL_SKIPPED = "mho_refit_skipped_updates_total{phase=rl}"
+
+
+def rl_devmetrics():
+    """Declare the RL train-step device metrics (frozen, trace-safe)."""
+    from multihop_offload_tpu.obs.devmetrics import DevMetrics
+
+    dm = DevMetrics()
+    dm.counter(DM_RL_EPISODES, "rollout episodes accumulated on device")
+    dm.counter(DM_RL_ROUNDS, "policy rounds executed inside rollouts")
+    dm.counter(DM_RL_REWARD_SUM, "reward first moment accumulator",
+               dtype=jnp.float32)  # fp32-island(reward moments accumulate wide by design)
+    dm.counter(DM_RL_REWARD_SQ, "reward second moment accumulator",
+               dtype=jnp.float32)  # fp32-island(second moments square small values)
+    dm.histogram(DM_RL_GRAD_NORM, tuple(10.0 ** e for e in range(-6, 4)),
+                 "per-episode global gradient norm (decade buckets)")
+    dm.counter(DM_RL_NONFINITE,
+               "train steps with non-finite mean gradients, counted "
+               "in-program")
+    dm.counter("mho_refit_skipped_updates_total",
+               "optimizer updates skipped on non-finite grads", phase="rl")
+    return dm.freeze()
+
+
+@struct.dataclass
+class RLStepOut:
+    """Host-visible result of one compiled train step."""
+
+    loss: jnp.ndarray        # () mean surrogate loss over the fleet
+    rewards: jnp.ndarray     # (F, R) per-lane per-round rewards
+    logps: jnp.ndarray       # (F, R) per-lane per-round action log-probs
+    deltas: RoundDeltas      # (F, R)-stacked counter deltas
+    dsts: jnp.ndarray        # (F, R, J) sampled destinations
+    routes: Any              # (F, R)-stacked SimRoutes in force
+    state: SimState          # (F,)-stacked terminal sim states
+    grad_norms: jnp.ndarray  # (F,) per-episode global gradient norms
+    skipped: jnp.ndarray     # () int32 1 when the update was skipped
+    dev_sim: Any = ()        # sim devmetrics window for this step
+    dev_rl: Any = ()         # RL devmetrics window for this step
+
+
+class RLTrainer:
+    """Compile-once driver for the on-device closed loop.
+
+    All static choices (spec, horizon, temperature, mesh) are fixed at
+    construction; `train_step` only feeds arrays, so repeated steps hit
+    one executable (the zero-unexpected-retrace gate in `cli.rl` holds it
+    to that).  `mesh` (a `parallel.mesh.make_mesh` mesh) shards the fleet
+    batch over the ``data`` axis with replicated params and a `pmean`
+    gradient reduction — the update itself runs replicated, so every
+    device steps to identical params.
+    """
+
+    def __init__(
+        self,
+        cfg: Config,
+        model,
+        variables,
+        spec: SimSpec,
+        mesh=None,
+        devmetrics: bool = True,
+        sim_dtype=jnp.float32,  # fp32-island(sim accumulators, matching FleetSim)
+    ):
+        self.cfg = cfg
+        self.model = model
+        self.spec = spec
+        self.mesh = mesh
+        self.rounds = int(cfg.rl_rounds)
+        self.slots_per_round = int(cfg.rl_slots)
+        self.sim_dtype = sim_dtype
+        self.params = variables["params"]
+        self.optimizer = make_optimizer(
+            dataclasses.replace(cfg, learning_rate=cfg.rl_lr)
+        )
+        self.opt_state = self.optimizer.init(self.params)
+        self.buf = buffer_init(int(cfg.rl_buffer))
+        # declared before the first trace — compile-time constants
+        self.dm_sim = sim_devmetrics(spec) if devmetrics else None
+        self.dm_rl = rl_devmetrics() if devmetrics else None
+        self.sim_totals: dict = {}
+        self.last_rl_metrics: Optional[dict] = None
+        self.steps = 0
+        lay = cfg.layout_policy
+        temperature = float(cfg.rl_temp)
+        delay_weight = float(cfg.rl_delay_weight)
+        ent_weight = float(cfg.rl_ent)
+        rounds, slots = self.rounds, self.slots_per_round
+        dm_sim, dm_rl = self.dm_sim, self.dm_rl
+        optimizer, max_norm = self.optimizer, float(cfg.max_norm)
+
+        def rollout_loss(params, inst, jobs, sp, st0, ir, key, baseline):
+            return rollout(
+                model, {"params": params}, inst, jobs, spec, sp, st0, ir,
+                key, baseline, rounds, slots, temperature, delay_weight,
+                ent_weight, dm=dm_sim, layout=lay,
+            )
+
+        def lane_rollouts(params, baseline, insts, jobss, paramss, states,
+                          init_rates, keys):
+            def one(i, jb, sp, st, ir, k):
+                return jax.value_and_grad(rollout_loss, has_aux=True)(
+                    params, i, jb, sp, st, ir, k, baseline
+                )
+
+            (losses, outs), grads = jax.vmap(one)(
+                insts, jobss, paramss, states, init_rates, keys
+            )
+            norms = episode_grad_norms(grads)
+            g = jax.tree_util.tree_map(
+                lambda x: jnp.mean(x, axis=0), grads
+            )
+            return g, losses, norms, outs
+
+        if mesh is not None:
+            P = PartitionSpec
+
+            def sharded(params, baseline, insts, jobss, paramss, states,
+                        init_rates, keys):
+                g, losses, norms, outs = lane_rollouts(
+                    params, baseline, insts, jobss, paramss, states,
+                    init_rates, keys,
+                )
+                # mean of per-shard means == global mean (equal shards)
+                g = jax.lax.pmean(g, "data")
+                return g, losses, norms, outs
+
+            fan = shard_map(
+                sharded, mesh=mesh,
+                in_specs=(P(), P(), P("data"), P("data"), P("data"),
+                          P("data"), P("data"), P("data")),
+                out_specs=(P(), P("data"), P("data"), P("data")),
+                check_vma=False,
+            )
+        else:
+            fan = lane_rollouts
+
+        def step_fn(params, opt_state, buf, insts, jobss, paramss, states,
+                    init_rates, keys):
+            baseline = buffer_baseline(buf)
+            g, losses, norms, outs = fan(
+                params, baseline, insts, jobss, paramss, states,
+                init_rates, keys,
+            )
+            # non-finite containment (`agent.replay.replay_apply` contract):
+            # a poisoned rollout must not corrupt Adam state on device
+            ok = jnp.asarray(True)
+            for leaf in jax.tree_util.tree_leaves(g):
+                ok = ok & jnp.all(jnp.isfinite(leaf))
+            safe_g = jax.tree_util.tree_map(
+                lambda x: jnp.where(jnp.isfinite(x), x, 0.0), g
+            )
+            updates, opt_new = optimizer.update(safe_g, opt_state, params)
+            p_new = apply_max_norm_constraint(
+                optax.apply_updates(params, updates), max_norm
+            )
+            # where-select whole trees: compiled shape never depends on `ok`
+            params2 = jax.tree_util.tree_map(
+                lambda new, old: jnp.where(ok, new, old), p_new, params
+            )
+            opt2 = jax.tree_util.tree_map(
+                lambda new, old: jnp.where(ok, new, old), opt_new, opt_state
+            )
+            skipped = jnp.where(ok, 0, 1).astype(jnp.int32)
+            round_mean = jnp.mean(
+                outs.rewards.astype(jnp.float32), axis=0  # fp32-island(reward statistics)
+            )
+            buf2 = buffer_push(buf, round_mean)
+            dev_rl: Any = ()
+            if dm_rl is not None:
+                fleet = keys.shape[0]
+                d = dm_rl.init()
+                d = dm_rl.inc(d, DM_RL_EPISODES, fleet)
+                d = dm_rl.inc(d, DM_RL_ROUNDS, fleet * rounds)
+                d = dm_rl.inc(d, DM_RL_REWARD_SUM, outs.rewards)
+                d = dm_rl.inc(d, DM_RL_REWARD_SQ,
+                              outs.rewards * outs.rewards)
+                d = dm_rl.observe(d, DM_RL_GRAD_NORM, norms)
+                d = dm_rl.inc(d, DM_RL_NONFINITE, ~ok)
+                d = dm_rl.inc(d, DM_RL_SKIPPED, skipped)
+                dev_rl = d
+            out = RLStepOut(
+                loss=jnp.mean(losses), rewards=outs.rewards,
+                logps=outs.logps, deltas=outs.deltas, dsts=outs.dsts,
+                routes=outs.routes, state=outs.state, grad_norms=norms,
+                skipped=skipped, dev_sim=outs.dev, dev_rl=dev_rl,
+            )
+            return params2, opt2, buf2, out
+
+        # registers with the prof layer on the first step (AOT compile +
+        # cost analysis under the name every step reuses)
+        self._step = obs_prof.wrap("rl/train_step", jax.jit(step_fn))
+
+    # ---- host-side driving ------------------------------------------------
+
+    def init_states(self, fleet: int) -> SimState:
+        s = init_state(self.spec, self.sim_dtype)
+        return jax.tree_util.tree_map(
+            lambda x: jnp.broadcast_to(x, (fleet,) + x.shape), s
+        )
+
+    def train_step(
+        self,
+        insts: Instance,
+        jobss: JobSet,
+        paramss,
+        keys: jax.Array,
+        states: Optional[SimState] = None,
+        init_rates: Optional[jnp.ndarray] = None,
+    ) -> RLStepOut:
+        """Run one compiled rollout+update step for the whole fleet batch."""
+        fleet = int(keys.shape[0])
+        if states is None:
+            states = self.init_states(fleet)
+        if init_rates is None:
+            init_rates = jnp.zeros((fleet, self.spec.num_jobs),
+                                   self.sim_dtype)
+        with span("rl/train_step", block=True, fleet=fleet):
+            t0 = time.perf_counter()  # nondet-ok(device-time accounting is a measurement)
+            params, opt_state, buf, out = self._step(
+                self.params, self.opt_state, self.buf, insts, jobss,
+                paramss, states, init_rates, keys,
+            )
+            jax.block_until_ready(out.loss)
+            self._step.account(time.perf_counter() - t0)  # nondet-ok(same measurement)
+        self.params, self.opt_state, self.buf = params, opt_state, buf
+        self.steps += 1
+        reg = registry()
+        reg.counter(
+            "mho_rl_steps_total", "compiled RL train steps executed"
+        ).inc()
+        reg.counter(
+            "mho_rl_episodes_total", "rollout episodes trained on"
+        ).inc(fleet)
+        if self.dm_sim is not None:
+            # rides the sync boundary the span above already paid for
+            flushed = self.dm_sim.flush(out.dev_sim, reg=reg, phase="rl")
+            for k, v in flushed.items():
+                if isinstance(v, dict):
+                    continue
+                self.sim_totals[k] = self.sim_totals.get(k, 0.0) + v
+        if self.dm_rl is not None:
+            self.last_rl_metrics = self.dm_rl.flush(out.dev_rl, reg=reg)
+        return out
+
+    def mark_steady(self) -> None:
+        """Call after the first completed step: later retraces count as
+        unexpected (`jax_unexpected_retraces_total`)."""
+        jaxhooks.mark_steady()
+
+    # ---- checkpoint interop ----------------------------------------------
+
+    def save(self, directory: str, step: Optional[int] = None,
+             extra: Optional[dict] = None) -> int:
+        """Persist params + optimizer state through `train.checkpoints`
+        with ``source="rl"`` lineage, so serve/ hot-reload and loop/ refit
+        can promote the RL candidate through their existing verified-
+        restore + signature-check paths."""
+        from multihop_offload_tpu.train import checkpoints as ckpt_lib
+
+        step = self.steps if step is None else int(step)
+        state = ckpt_lib.plain_state({
+            "params": self.params,
+            "opt_state": self.opt_state,
+        })
+        lineage = ckpt_lib.make_lineage(
+            "rl", cfg=self.cfg,
+            extra={"rl_step": step, "rounds": self.rounds,
+                   "slots_per_round": self.slots_per_round,
+                   **(extra or {})},
+        )
+        ckpt_lib.save_checkpoint(directory, step, state, lineage=lineage)
+        return step
+
+
+def make_eval(cfg: Config, model, spec: SimSpec):
+    """Compile-once sampling-policy evaluator.
+
+    Runs the SAME stochastic policy the trainer optimizes (temperature
+    included) over a fleet batch and returns the stacked terminal
+    `SimState`s — the honest A/B surface for "did the learned policy beat
+    its random init": both contenders run one executable on identical
+    instances, keys and horizons, only the params differ.
+    """
+    lay = cfg.layout_policy
+    rounds, slots = int(cfg.rl_rounds), int(cfg.rl_slots)
+    temperature = float(cfg.rl_temp)
+    delay_weight = float(cfg.rl_delay_weight)
+
+    @jax.jit
+    def ev(params, insts, jobss, paramss, states, init_rates, keys):
+        def one(i, jb, sp, st, ir, k):
+            _, out = rollout(
+                model, {"params": params}, i, jb, spec, sp, st, ir, k,
+                0.0, rounds, slots, temperature, delay_weight, layout=lay,
+            )
+            return out.state
+
+        return jax.vmap(one)(insts, jobss, paramss, states, init_rates,
+                             keys)
+
+    return ev
+
+
+def delivered_ratio(states: SimState) -> float:
+    """Fleet-wide delivered/generated of stacked terminal states."""
+    st = jax.tree_util.tree_map(np.asarray, states)
+    gen = float(np.sum(st.generated))
+    return float(np.sum(st.delivered)) / max(gen, 1.0)

@@ -67,6 +67,24 @@ def load_integrity(directory: str, step: int) -> Optional[dict]:
     return load_json(_integrity_path(directory, step))
 
 
+def plain_state(tree: Any) -> Any:
+    """Normalize a pytree to the containers a TEMPLATE-FREE restore gives
+    back: namedtuples (optax states) and tuples become lists, mappings
+    become dicts, leaves become host numpy arrays.  A state saved through
+    this round-trips `restore_verified` bit for bit — the raw namedtuple
+    would re-hash under different key paths (`.count` vs `['count']`)
+    after orbax's container conversion and be quarantined as corrupt.
+    Data and leaf order are untouched; restore into a live optax structure
+    with `tree_unflatten` over the live treedef."""
+    if hasattr(tree, "_asdict"):
+        return {k: plain_state(v) for k, v in tree._asdict().items()}
+    if isinstance(tree, (tuple, list)):
+        return [plain_state(v) for v in tree]
+    if hasattr(tree, "items"):
+        return {str(k): plain_state(v) for k, v in tree.items()}
+    return np.asarray(tree)
+
+
 def save_checkpoint(directory: str, step: int, state: Any,
                     lineage: Optional[dict] = None) -> None:
     """state: any pytree (params / opt_state / counters).
@@ -103,7 +121,8 @@ def make_lineage(source: str, parent_step: Optional[int] = None,
     """Provenance record for a checkpoint: who trained it, from what.
 
     source: "offline" (file-visit Trainer), "refit" (loop/ background
-    trainer), or "rollback" (promotion controller re-pinning a champion).
+    trainer), "rl" (the on-device closed-loop trainer, `rl.RLTrainer`),
+    or "rollback" (promotion controller re-pinning a champion).
     """
     from multihop_offload_tpu.obs import events as obs_events
 

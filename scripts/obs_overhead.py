@@ -36,7 +36,7 @@ apply_platform_env()
 import jax  # noqa: E402
 
 
-def devmetrics_legs(reps: int, legs: int = 3):
+def devmetrics_legs(reps: int, legs: int = 5):
     """Bare vs devmetrics-threaded FleetSim on a tiny fleet.
 
     The SAME stacked inputs run through two compiled sim programs — one
@@ -81,6 +81,51 @@ def devmetrics_legs(reps: int, legs: int = 3):
             for _ in range(reps):
                 run = sim.run(insts, jobs, params, keys)
             jax.block_until_ready(run.state)
+            times[name].append(time.perf_counter() - t0)
+    return times["bare"], times["inst"]
+
+
+def rl_legs(reps: int, legs: int = 5):
+    """Bare vs devmetrics-instrumented RL train step on a tiny fleet.
+
+    Two `rl.RLTrainer` compiled steps over the SAME fleet batch: one with
+    devmetrics off, one carrying BOTH accumulator windows (sim counters
+    through the rollout scan + the RL reward/grad-norm window) and paying
+    the two registry flushes at the step's sync boundary.  Interleaved
+    timed legs, per-leg minima — the gate is the same <2% budget the
+    other instrumentation paths answer to."""
+    import jax.numpy as jnp
+
+    from multihop_offload_tpu.cli.rl import build_fleet
+    from multihop_offload_tpu.config import Config
+    from multihop_offload_tpu.layouts import zeros_support
+    from multihop_offload_tpu.models import make_model
+    from multihop_offload_tpu.rl import RLTrainer
+
+    cfg = Config(sim_nodes=8, sim_jobs=3, sim_cap=64,
+                 rl_fleet=2, rl_rounds=2, rl_slots=100)
+    insts, jobss, paramss, spec, pad = build_fleet(cfg)
+    model = make_model(cfg)
+    variables = model.init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((pad.e, 4), cfg.jnp_dtype),
+        zeros_support(pad, cfg.jnp_dtype, cfg.layout_policy),
+    )
+    trainers = {
+        "bare": RLTrainer(cfg, model, variables, spec, devmetrics=False),
+        "inst": RLTrainer(cfg, model, variables, spec),
+    }
+    keys = jax.random.split(jax.random.PRNGKey(1), cfg.rl_fleet)
+    for tr in trainers.values():  # compile + first flush outside the clock
+        tr.train_step(insts, jobss, paramss, keys)
+
+    times = {"bare": [], "inst": []}
+    for _ in range(legs):
+        for name, tr in trainers.items():
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                out = tr.train_step(insts, jobss, paramss, keys)
+            jax.block_until_ready(out.loss)
             times[name].append(time.perf_counter() - t0)
     return times["bare"], times["inst"]
 
@@ -160,13 +205,20 @@ def main() -> int:
         obs.finish_run(runlog)
     jaxhooks.clear_steady()
 
-    sim_reps = int(os.environ.get("OBS_OVERHEAD_SIM_REPS", 10))
+    # the tiny sim/rl steps are ~35 ms, so short legs can't resolve a 2%
+    # signal over host jitter — 40 reps x 5 interleaved legs keeps each
+    # leg >1 s and the per-leg minimum honest
+    sim_reps = int(os.environ.get("OBS_OVERHEAD_SIM_REPS", 40))
     dm_bare, dm_inst = devmetrics_legs(sim_reps)
+    rl_reps = int(os.environ.get("OBS_OVERHEAD_RL_REPS", 40))
+    rl_bare, rl_inst = rl_legs(rl_reps)
 
     t_bare, t_inst = min(bare), min(inst)
     overhead = t_inst / t_bare - 1.0
     td_bare, td_inst = min(dm_bare), min(dm_inst)
     dm_overhead = td_inst / td_bare - 1.0
+    tr_bare, tr_inst = min(rl_bare), min(rl_inst)
+    rl_overhead = tr_inst / tr_bare - 1.0
     rec = {
         "description": "jitted forward_backward step loop, bare vs fully "
                        "instrumented (span + registry observe + JSONL step "
@@ -185,15 +237,28 @@ def main() -> int:
                                   "devmetrics=False vs the accumulator "
                                   "pytree threaded through the scan + "
                                   "flush at the existing sync boundary; "
-                                  "per-leg minima over 3 interleaved legs",
+                                  "per-leg minima over 5 interleaved legs",
         "devmetrics_reps_per_leg": sim_reps,
         "devmetrics_bare_s": round(td_bare, 4),
         "devmetrics_instrumented_s": round(td_inst, 4),
         "devmetrics_bare_legs_s": [round(x, 4) for x in dm_bare],
         "devmetrics_instrumented_legs_s": [round(x, 4) for x in dm_inst],
         "devmetrics_overhead_frac": round(dm_overhead, 5),
+        "rl_description": "rl.RLTrainer compiled train step (2 lanes, 2 "
+                          "rounds x 100 slots), devmetrics=False vs both "
+                          "accumulator windows (in-scan sim counters + RL "
+                          "reward/grad-norm metrics) with their registry "
+                          "flushes at the step's sync boundary; per-leg "
+                          "minima over 5 interleaved legs",
+        "rl_reps_per_leg": rl_reps,
+        "rl_bare_s": round(tr_bare, 4),
+        "rl_instrumented_s": round(tr_inst, 4),
+        "rl_bare_legs_s": [round(x, 4) for x in rl_bare],
+        "rl_instrumented_legs_s": [round(x, 4) for x in rl_inst],
+        "rl_overhead_frac": round(rl_overhead, 5),
         "budget_frac": 0.02,
-        "pass": bool(overhead < 0.02 and dm_overhead < 0.02),
+        "pass": bool(overhead < 0.02 and dm_overhead < 0.02
+                     and rl_overhead < 0.02),
     }
     os.makedirs(os.path.dirname(OUT), exist_ok=True)
     with open(OUT, "w") as f:

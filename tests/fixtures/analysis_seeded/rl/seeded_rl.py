@@ -1,0 +1,40 @@
+"""Seeded violations: JX009 (host sync / callback in a rollout-scan body).
+
+Every pattern the rule exists to catch, inside bodies that actually feed
+`jax.lax.scan` — plus one waived line proving the `# rollout-ok(<why>)`
+escape hatch suppresses a finding without silencing the rest.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def bad_rollout(state0, keys):
+    def round_body(carry, key):
+        jax.debug.callback(lambda c: None, carry)  # JX009: host callback in scan
+        total = float(np.sum(carry))  # JX009: host numpy inside the scan
+        flag = carry.item()  # JX009: .item() device->host sync per round
+        return carry + total + flag, None
+
+    out, _ = lax.scan(round_body, state0, keys)
+    return out
+
+
+def lambda_rollout(state0, keys):
+    # JX009: io_callback inside an inline lambda scan body
+    out, _ = lax.scan(
+        lambda c, k: (jax.experimental.io_callback(print, None, c), None),
+        state0, keys,
+    )
+    return out
+
+
+def waived_rollout(state0, keys):
+    def round_body(carry, key):
+        jax.debug.print("r={r}", r=carry)  # rollout-ok(one-off debug session, removed before merge)
+        return carry + jnp.sum(key), None
+
+    out, _ = lax.scan(round_body, state0, keys)
+    return out

@@ -21,8 +21,8 @@ from multihop_offload_tpu.analysis.cli import main as lint_main
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SEEDED = os.path.join(REPO, "tests", "fixtures", "analysis_seeded")
 ALL_REPO_RULES = {"JX001", "JX002", "JX003", "JX004", "JX005", "JX006",
-                  "JX007", "JX008", "MP001", "SL001", "OB001", "OB002",
-                  "OB003"}
+                  "JX007", "JX008", "JX009", "MP001", "SL001", "OB001",
+                  "OB002", "OB003"}
 
 
 def run_on(tmp_path, files, select=None, baseline=None):
@@ -525,6 +525,69 @@ def test_jx008_scoped_to_queueing_dirs(tmp_path):
     assert "JX008" not in rules_hit(rep)
     rep = run_on(tmp_path, {"sim/m.py": src, "loop/m.py": src})
     assert "JX008" in rules_hit(rep)
+
+
+def test_jx009_tp_waived_and_clean_scan_bodies(tmp_path):
+    rep = run_on(tmp_path, {"rl/m.py": """\
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax import lax
+
+        def tp_rollout(state0, keys):
+            def round_body(carry, key):
+                jax.debug.callback(lambda c: None, carry)
+                total = float(np.sum(carry))
+                flag = carry.item()
+                return carry + total + flag, None
+            out, _ = lax.scan(round_body, state0, keys)
+            return out
+
+        def tp_lambda(state0, keys):
+            out, _ = lax.scan(
+                lambda c, k: (jax.experimental.io_callback(print, None, c),
+                              None),
+                state0, keys)
+            return out
+
+        def waived(state0, keys):
+            def round_body(carry, key):
+                jax.debug.print("r={r}", r=carry)  # rollout-ok(debug)
+                return carry, None
+            out, _ = lax.scan(round_body, state0, keys)
+            return out
+
+        def clean(state0, keys):
+            def round_body(carry, key):
+                return carry + jnp.sum(key), None
+            out, _ = lax.scan(round_body, state0, keys)
+            return out
+
+        def outside_scan_is_fine(x):
+            # host numpy OUTSIDE any scan body: not this rule's business
+            return float(np.sum(x)) + x.item()
+    """})
+    jx = [f for f in rep.findings if f.rule == "JX009"]
+    assert [f.line for f in jx] == [8, 9, 10, 17]
+    assert len([f for f in rep.waived if f.rule == "JX009"]) == 1
+
+
+def test_jx009_scoped_to_rl(tmp_path):
+    src = """\
+        import numpy as np
+        from jax import lax
+
+        def rollout(state0, keys):
+            def round_body(carry, key):
+                return carry + float(np.sum(carry)), None
+            out, _ = lax.scan(round_body, state0, keys)
+            return out
+    """
+    rep = run_on(tmp_path, {"sim/m.py": src, "agent/m.py": src,
+                            "cli/m.py": src})
+    assert "JX009" not in rules_hit(rep)
+    rep = run_on(tmp_path, {"rl/m.py": src})
+    assert "JX009" in rules_hit(rep)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,284 @@
+"""rl/ subsystem: the Anakin closed loop, proven piece by piece.
+
+- rollout reward math vs a hand-stepped tiny sim (same keys, same routes
+  -> identical counters; rewards recomputed from the exposed deltas)
+- on-device buffer carry round-trip (structure-stable, correct baseline,
+  ring eviction)
+- zero unexpected retraces across repeated compiled train steps
+- delivered-ratio improvement over random init on a fixed seed (the
+  acceptance gate, exercised through the CLI's own run_train)
+- sharded-vs-single-device update parity on the 8-virtual-device mesh
+- checkpoint interop: source="rl" lineage, verified restore, and the
+  serve/ hot-reload signature pin
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from multihop_offload_tpu.cli.rl import build_fleet, run_train
+from multihop_offload_tpu.config import Config
+from multihop_offload_tpu.layouts import zeros_support
+from multihop_offload_tpu.models import make_model
+from multihop_offload_tpu.obs import jaxhooks
+from multihop_offload_tpu.rl import (
+    RLBuffer,
+    RLTrainer,
+    buffer_baseline,
+    buffer_init,
+    buffer_push,
+    reward_from_deltas,
+    rollout,
+)
+from multihop_offload_tpu.sim.state import init_state
+from multihop_offload_tpu.sim.step import sim_slot_step
+
+TINY = Config(sim_nodes=8, sim_jobs=3, sim_cap=64,
+              rl_fleet=2, rl_rounds=2, rl_slots=40, rl_steps=3)
+
+
+@pytest.fixture(scope="module")
+def tiny_fleet():
+    return build_fleet(TINY)
+
+
+@pytest.fixture(scope="module")
+def tiny_model(tiny_fleet):
+    _, _, _, _, pad = tiny_fleet
+    model = make_model(TINY)
+    variables = model.init(
+        jax.random.PRNGKey(TINY.seed),
+        jnp.zeros((pad.e, 4), TINY.jnp_dtype),
+        zeros_support(pad, TINY.jnp_dtype, TINY.layout_policy),
+    )
+    return model, variables
+
+
+def _lane(tree, i):
+    return jax.tree_util.tree_map(lambda x: x[i], tree)
+
+
+# ---------------------------------------------------------------------------
+# rollout reward math vs a hand-stepped sim
+# ---------------------------------------------------------------------------
+
+
+def test_rollout_matches_hand_stepped_sim(tiny_fleet, tiny_model):
+    """The rollout's inner dynamics ARE `sim_slot_step`: replaying its own
+    sampled routes through a host-driven slot loop with the identical key
+    schedule must land on the same terminal counters, and the rewards must
+    equal the reward spec applied to the exposed per-round deltas."""
+    insts, jobss, paramss, spec, _ = tiny_fleet
+    model, variables = tiny_model
+    inst, jobs, sp = _lane(insts, 0), _lane(jobss, 0), _lane(paramss, 0)
+    st0 = init_state(spec, jnp.float32)
+    rates0 = jnp.zeros((spec.num_jobs,), jnp.float32)
+    key = jax.random.PRNGKey(42)
+    rounds, slots = TINY.rl_rounds, TINY.rl_slots
+
+    loss, out = jax.jit(
+        lambda v, k: rollout(model, v, inst, jobs, spec, sp, st0, rates0,
+                             k, 0.0, rounds, slots,
+                             TINY.rl_temp, TINY.rl_delay_weight)
+    )(variables, key)
+
+    # hand-step: same key tree (round keys -> (k_dec, k_slots) -> slot
+    # keys), same per-round routes (read back off the rollout's own tape)
+    step1 = jax.jit(
+        lambda routes, state, k: sim_slot_step(
+            inst, spec, sp, routes, jobs, state, k
+        )[0]
+    )
+    st = st0
+    hand_rewards = []
+    for r in range(rounds):
+        kr = jax.random.split(key, rounds)[r]
+        _, k_slots = jax.random.split(kr)
+        routes_r = _lane(out.routes, r)
+        before = st
+        for kk in jax.random.split(k_slots, slots):
+            st = step1(routes_r, st, kk)
+        gen_d = int(np.sum(np.asarray(st.generated - before.generated)))
+        del_d = int(np.sum(np.asarray(st.delivered - before.delivered)))
+        drop_d = int(np.sum(np.asarray(st.dropped - before.dropped)))
+        delay_d = float(np.sum(np.asarray(st.delay_sum - before.delay_sum)))
+        assert gen_d == int(out.deltas.generated[r])
+        assert del_d == int(out.deltas.delivered[r])
+        assert drop_d == int(out.deltas.dropped[r])
+        np.testing.assert_allclose(delay_d, float(out.deltas.delay_sum[r]),
+                                   rtol=1e-6)
+        hand_rewards.append(float(reward_from_deltas(
+            jnp.asarray(gen_d), jnp.asarray(del_d),
+            jnp.asarray(delay_d, jnp.float32), sp.dt,
+            TINY.rl_delay_weight,
+        )))
+
+    # terminal counters: identical packets, bit for bit
+    np.testing.assert_array_equal(np.asarray(st.generated),
+                                  np.asarray(out.state.generated))
+    np.testing.assert_array_equal(np.asarray(st.delivered),
+                                  np.asarray(out.state.delivered))
+    np.testing.assert_array_equal(np.asarray(st.dropped),
+                                  np.asarray(out.state.dropped))
+    np.testing.assert_allclose(np.asarray(out.rewards),
+                               np.asarray(hand_rewards), rtol=1e-6)
+    # surrogate loss composes the exposed pieces
+    np.testing.assert_allclose(
+        float(loss),
+        float(-np.sum(np.asarray(out.logps) * np.asarray(out.rewards))),
+        rtol=1e-5,
+    )
+
+
+# ---------------------------------------------------------------------------
+# buffer carry
+# ---------------------------------------------------------------------------
+
+
+def test_buffer_round_trip_structure_and_baseline():
+    buf = buffer_init(4)
+    td0 = jax.tree_util.tree_structure(buf)
+    assert float(buffer_baseline(buf)) == 0.0  # empty -> zero baseline
+
+    buf = buffer_push(buf, jnp.asarray([1.0, 2.0], jnp.float32))
+    assert jax.tree_util.tree_structure(buf) == td0
+    assert buf.rewards.dtype == jnp.float32 and buf.count.dtype == jnp.int32
+    assert float(buffer_baseline(buf)) == pytest.approx(1.5)
+
+    # wraparound evicts oldest-first: [1,2,3,4,5,6] in cap 4 -> [3,4,5,6]
+    buf = buffer_push(buf, jnp.asarray([3.0, 4.0, 5.0, 6.0], jnp.float32))
+    assert int(buf.count) == 4
+    assert float(buffer_baseline(buf)) == pytest.approx((3 + 4 + 5 + 6) / 4)
+
+    # jittable as a carry: structure in == structure out under jit
+    jit_push = jax.jit(buffer_push)
+    buf2 = jit_push(buf, jnp.asarray([7.0], jnp.float32))
+    assert jax.tree_util.tree_structure(buf2) == td0
+    assert isinstance(buf2, RLBuffer)
+    assert float(buffer_baseline(buf2)) == pytest.approx((4 + 5 + 6 + 7) / 4)
+
+
+# ---------------------------------------------------------------------------
+# one steady compiled program
+# ---------------------------------------------------------------------------
+
+
+def test_zero_unexpected_retraces_across_steps(tiny_fleet, tiny_model):
+    insts, jobss, paramss, spec, _ = tiny_fleet
+    model, variables = tiny_model
+    tr = RLTrainer(TINY, model, variables, spec)
+    jaxhooks.install()
+    key = jax.random.PRNGKey(7)
+
+    key, k = jax.random.split(key)
+    tr.train_step(insts, jobss, paramss, jax.random.split(k, TINY.rl_fleet))
+    tr.mark_steady()
+    before = jaxhooks.unexpected_retraces()
+    for _ in range(3):
+        key, k = jax.random.split(key)
+        out = tr.train_step(insts, jobss, paramss,
+                            jax.random.split(k, TINY.rl_fleet))
+    jaxhooks.clear_steady()
+    assert jaxhooks.unexpected_retraces() == before, (
+        "repeated train steps retraced — the step is not one steady program"
+    )
+    assert int(out.skipped) == 0
+    assert np.isfinite(float(out.loss))
+
+
+# ---------------------------------------------------------------------------
+# the acceptance gate, through the CLI's own driver
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_smoke_improves_over_random_init():
+    cfg = dataclasses.replace(
+        Config(), sim_nodes=8, sim_jobs=3, sim_cap=64,
+        rl_fleet=4, rl_rounds=2, rl_slots=100, rl_steps=20,
+    )
+    record = run_train(cfg, smoke=True)  # asserts its own gates
+    assert record["improved"]
+    assert record["unexpected_retraces"] == 0
+    assert record["conservation"]["exact"]
+    assert record["rho_target"] >= 0.7
+
+
+# ---------------------------------------------------------------------------
+# sharded-vs-single-device parity
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_update_parity_on_virtual_mesh(tiny_model):
+    """Same fleet batch, same keys: the shard_map(data=8) step and the
+    single-device step must produce the same updated params (the pmean of
+    per-shard grad means equals the global mean up to reduction order)."""
+    from multihop_offload_tpu.parallel.mesh import make_mesh
+
+    if jax.device_count() < 8:
+        pytest.skip("needs the 8-virtual-device CPU mesh")
+    cfg = dataclasses.replace(TINY, rl_fleet=8, rl_slots=20)
+    insts, jobss, paramss, spec, _ = build_fleet(cfg)
+    model, variables = tiny_model
+    keys = jax.random.split(jax.random.PRNGKey(5), 8)
+
+    tr_single = RLTrainer(cfg, model, variables, spec, devmetrics=False)
+    tr_shard = RLTrainer(cfg, model, variables, spec,
+                         mesh=make_mesh(8, 1), devmetrics=False)
+    out_s = tr_single.train_step(insts, jobss, paramss, keys)
+    out_p = tr_shard.train_step(insts, jobss, paramss, keys)
+
+    # identical rollouts (per-lane outputs don't cross the reduction)...
+    np.testing.assert_array_equal(np.asarray(out_s.rewards),
+                                  np.asarray(out_p.rewards))
+    # ...and matching updates up to fp reduction order in the grad mean
+    flat_s = jax.tree_util.tree_leaves(tr_single.params)
+    flat_p = jax.tree_util.tree_leaves(tr_shard.params)
+    for a, b in zip(flat_s, flat_p):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint interop: rl lineage -> verified restore -> serve signature pin
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_interop_rl_lineage_and_signature(tmp_path, tiny_fleet,
+                                                     tiny_model):
+    from multihop_offload_tpu.serve.executor import param_signature
+    from multihop_offload_tpu.train import checkpoints as ckpt_lib
+
+    insts, jobss, paramss, spec, pad = tiny_fleet
+    model, variables = tiny_model
+    tr = RLTrainer(TINY, model, variables, spec, devmetrics=False)
+    tr.train_step(insts, jobss, paramss,
+                  jax.random.split(jax.random.PRNGKey(3), TINY.rl_fleet))
+    directory = str(tmp_path / "orbax_rl")
+    step = tr.save(directory)
+
+    # lineage names the rl source (the flywheel's provenance contract)
+    lin = ckpt_lib.load_lineage(directory, step)
+    assert lin is not None and lin["source"] == "rl"
+    assert lin["rl_step"] == step
+
+    # verified restore (integrity sidecar honored), bit-compatible payload
+    restored, got = ckpt_lib.restore_verified(directory)
+    assert got == step and restored is not None
+    saved_params = jax.tree_util.tree_map(np.asarray, tr.params)
+    assert (ckpt_lib.tree_checksum(restored["params"])
+            == ckpt_lib.tree_checksum(saved_params))
+
+    # the serve/ hot-reload gate: an RL checkpoint must be swappable for a
+    # fresh-init tree of the same config without retrace/reshape
+    fresh = make_model(TINY).init(
+        jax.random.PRNGKey(TINY.seed + 9),
+        jnp.zeros((pad.e, 4), TINY.jnp_dtype),
+        zeros_support(pad, TINY.jnp_dtype, TINY.layout_policy),
+    )["params"]
+    assert param_signature(restored["params"]) == param_signature(fresh)
+    # and loop/ refit resumes the SAME optimizer moments, not a cold Adam
+    assert "opt_state" in restored
