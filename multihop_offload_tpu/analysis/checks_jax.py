@@ -19,6 +19,10 @@ JX009 rollout purity    host sync / callback (`.item()`, `np.*`,
                         `jax.debug.callback` / `io_callback`) inside an
                         rl/ rollout-scan body — the Anakin closed loop
                         must stay one compiled program
+JX010 mesh bring-up     `jax.distributed.initialize` / process-index
+                        branching outside multihost/ — process-group
+                        formation has one owner (multihost.runtime), so
+                        retry/backoff/idempotence live in one place
 
 JX001 runs a small intraprocedural taint pass over each jit-reachable
 function (see `reachability`): values produced by `jax.*` calls are
@@ -649,3 +653,47 @@ def check_jx009(mod: ModuleCtx) -> Iterator[Finding]:
                          "or waive with '# rollout-ok(<why>)'"),
                 snippet=_snippet(mod, node),
             )
+
+
+# ---------------------------------------------------------------------------
+# JX010 — process-group bring-up outside multihost/
+# ---------------------------------------------------------------------------
+
+_JX010_BRINGUP = {"jax.distributed.initialize", "jax.distributed.shutdown"}
+_JX010_TOPOLOGY = {"jax.process_index", "jax.process_count"}
+
+
+@rule(
+    id="JX010", severity="error",
+    scope="package (multihost/ exempt)",
+    waiver="# mesh-ok(",
+    doc=("`jax.distributed.initialize` or process-index/count branching "
+         "outside multihost/ — mesh bring-up has ONE owner "
+         "(`multihost.runtime`: retry, backoff, idempotence, env fallback); "
+         "a second initialize call crashes the runtime, and ad-hoc "
+         "process-index forks drift from the federation's host naming"),
+    exempt_dirs=("multihost",),
+)
+def check_jx010(mod: ModuleCtx) -> Iterator[Finding]:
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        canon = mod.canonical(node.func) if isinstance(
+            node.func, (ast.Name, ast.Attribute)) else None
+        if canon in _JX010_BRINGUP:
+            msg = (f"{canon}() outside multihost/ — call "
+                   "multihost.runtime.bootstrap()/init_distributed() "
+                   "instead (initialize is once-per-process; the runtime "
+                   "module owns the guard, retries and env fallback)")
+        elif canon in _JX010_TOPOLOGY:
+            msg = (f"{canon}() outside multihost/ — route topology "
+                   "decisions through multihost.runtime (MeshRuntime / "
+                   "host_name) so host naming matches the federation's "
+                   "labels")
+        else:
+            continue
+        yield Finding(
+            rule="JX010", path=mod.path, line=node.lineno,
+            message=(msg + ", or waive with '# mesh-ok(<why>)'"),
+            snippet=_snippet(mod, node),
+        )

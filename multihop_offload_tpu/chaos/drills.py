@@ -859,6 +859,118 @@ class ChaosSmoke:
         }
         return self._finish(rec)
 
+    def run_host_loss(self) -> dict:
+        """Kill-a-whole-host: the local fleet is split into two pseudo-hosts
+        and buckets laid over them by the two-level DCN-aware planner
+        (`multihost.plan`); losing a host must force a re-plan that moves
+        every one of its buckets onto the survivor's chips WITHOUT crossing
+        the host split, decisions must stay bit-identical-or-honestly-
+        baseline, conservation must hold, and the takeover compiles must be
+        expected rebuilds (zero unexpected retraces).  The cross-PROCESS
+        version of this drill is `mho-mesh --smoke`; this in-process twin
+        keeps the planner/executor contract in the chaos matrix.  Skips
+        gracefully below 4 devices (2 hosts x 2 chips)."""
+        import jax
+
+        from multihop_offload_tpu.cli.serve import build_service
+        from multihop_offload_tpu.multihost.plan import (
+            TwoLevelPlanner, validate_plan,
+        )
+        from multihop_offload_tpu.obs import jaxhooks
+        from multihop_offload_tpu.serve.placement import PlacementPlan
+        from multihop_offload_tpu.serve.workload import request_stream
+
+        n_dev = len(jax.devices())
+        if n_dev < 4:
+            rec = {
+                "name": "host_loss",
+                "injected": None, "recovered": True,
+                "skipped": f"needs >= 4 devices (2 hosts x 2 chips), host "
+                           f"has {n_dev} (XLA_FLAGS=--xla_force_host_"
+                           "platform_device_count=8 for the CPU proof)",
+                "checks": {"skipped_gracefully": True},
+            }
+            return self._finish(rec)
+
+        cfg = dataclasses.replace(
+            self._drill_cfg("host_loss"),
+            # two buckets so level 1 has something to spread across hosts
+            serve_sizes="10,14", serve_buckets=2,
+            serve_mesh=4, serve_replan_ticks=10**9,  # placement injected
+        )
+        svc, pool = build_service(cfg, clock=self.clock)
+        devs = list(jax.devices())[:4]
+        hosts = {"hostA": devs[:2], "hostB": devs[2:]}
+        n_buckets = len(svc.buckets.pads)
+        planner = TwoLevelPlanner(n_buckets, hosts, slots=svc.executor.slots)
+        planner.observe([3.0, 2.0][:n_buckets] or [3.0])
+        plan = planner.replan()
+        validate_plan(plan, hosts)   # DCN invariant before anything compiles
+        svc.executor.set_placement(PlacementPlan(plan.devices))
+
+        def window(id_offset: int, count: int = 6) -> dict:
+            pending = list(request_stream(
+                pool, count, seed=cfg.seed + 1 + id_offset,
+                arrival_scale=cfg.arrival_scale, ul=cfg.ul_data,
+                dl=cfg.dl_data, t_max=float(cfg.T), id_offset=id_offset,
+            ))
+            pending.reverse()
+            out = {}
+            while pending or svc.queue_depth:
+                while pending:
+                    req = pending.pop()
+                    if not svc.submit(req):
+                        pending.append(req)
+                        break
+                for r in svc.tick():
+                    out[r.request_id] = r
+            return out
+
+        golden = window(id_offset=110_000)
+        spans_hosts = len(set(plan.hosts)) > 1
+        jaxhooks.install()
+        retraces_before = jaxhooks.unexpected_retraces()
+        jaxhooks.mark_steady()
+        try:
+            plan2 = planner.remove_host("hostB")   # forced: invalid plan
+            lost_chips = set(hosts["hostB"])
+            svc.executor.set_placement(PlacementPlan(plan2.devices))
+            after = window(id_offset=110_000)      # same ids, survivor only
+            retraces = jaxhooks.unexpected_retraces() - retraces_before
+        finally:
+            jaxhooks.clear_steady()
+        survived = {
+            rid: (np.array_equal(r.dst, golden[rid].dst)
+                  and np.array_equal(r.is_local, golden[rid].is_local))
+            or r.served_by == "baseline"
+            for rid, r in after.items()
+        }
+        plan3 = planner.add_host("hostB", hosts["hostB"])
+        rec = {
+            "name": "host_loss",
+            "injected": "pseudo-host hostB (2 chips) dropped from a "
+                        "2-host fleet mid-serving",
+            "recovered": True,
+            "checks": {
+                "plan_spans_hosts_before_loss": spans_hosts,
+                "forced_replan_excludes_victim": all(
+                    h == "hostA" for h in plan2.hosts
+                ) and not any(
+                    d in lost_chips for ds in plan2.devices for d in ds
+                ),
+                "decisions_never_wrong": bool(survived)
+                and all(survived.values()),
+                "conservation": (
+                    svc.stats.admitted == svc.stats.served
+                    and svc.queue_depth == 0
+                ),
+                "zero_unexpected_retraces": retraces == 0,
+                "host_restored": "hostB" in planner.hosts
+                and validate_plan(plan3, planner.hosts) is None,
+            },
+        }
+        return self._finish(rec)
+
     # ---- retrace discipline ------------------------------------------------
 
     def run_no_retrace_after_recovery(self) -> dict:
@@ -914,6 +1026,7 @@ class ChaosSmoke:
         self.run_cooldown_restart()
         self.run_candidate_gc()
         self.run_device_loss()
+        self.run_host_loss()
         self.run_no_retrace_after_recovery()
         reg = obs_registry()
         record = {

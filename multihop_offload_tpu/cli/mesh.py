@@ -1,0 +1,521 @@
+"""`mho-mesh` — multi-host mesh serving, provable on one CPU box.
+
+`--smoke` forms a REAL `jax.distributed` process group out of local
+subprocesses (each worker gets its own virtual-device fleet via
+`XLA_FLAGS=--xla_force_host_platform_device_count`), lays buckets over the
+hosts with the two-level DCN-aware planner, serves identical request
+streams on both sides of the host boundary, and proves the claims the
+multihost subsystem makes:
+
+  * >1 process served traffic — read off the FEDERATED `host=`-labeled
+    `mho_serve_served_total` counters scraped from each worker's live
+    Prometheus endpoint, not off the coordinator's bookkeeping;
+  * decisions are bit-identical to the single-host path — every worker
+    response is digested (dst / is_local / served_by) and compared against
+    a single-process reference service fed the SAME request stream;
+  * kill-a-whole-host — the victim worker is SIGKILLed mid-run, the
+    planner force-replans (hysteresis cannot hold a dead host), survivors
+    re-serve the victim's buckets bit-identically, request conservation
+    holds, and the survivor reports ZERO unexpected retraces (takeover
+    compiles happen inside `expected_rebuild`, like any planned build);
+  * the open-loop bisection (`loadgen.search`) reports a finite sustained
+    req/s at the p99 time-in-system SLO — the headline number — into
+    `benchmarks/mesh_smoke.json`.
+
+Coordinator <-> worker protocol: JSON lines over the worker's stdin /
+stdout, every protocol line prefixed `MHO-MESH ` so build chatter on
+stdout cannot corrupt it.  Workers are plain `mho-mesh --worker`
+processes; `multihost.runtime.worker_env` builds their environment.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import queue
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+PREFIX = "MHO-MESH "
+DEFAULT_OUT = "benchmarks/mesh_smoke.json"
+
+# smoke geometry: 2 hosts x 2 virtual chips, 2 buckets, 2 slots each —
+# small enough to compile in seconds, wide enough that both the DCN level
+# and the ICI level of the planner do real work
+SMOKE_HOSTS = 2
+SMOKE_LOCAL_DEVICES = 2
+SMOKE_SEED = 17
+WINDOW_1 = 32          # both hosts serving
+WINDOW_2 = 24          # after the kill, survivors only
+TICK_S = 0.02          # virtual tick interval for window serving
+OPEN_LOOP_N = 120      # requests per bisection probe
+OPEN_LOOP_SLO_P99_S = 0.25
+
+
+def _smoke_config():
+    """One Config for every process — the pool, buckets, and model init
+    derive from it, which is what makes per-host weight replication and
+    stream regeneration exact."""
+    from multihop_offload_tpu.config import Config
+
+    return Config(
+        serve_sizes="10,14", serve_buckets=2, serve_slots=2,
+        serve_queue_cap=64, serve_deadline_s=60.0,
+        serve_replan_ticks=10**9,  # placement is injected, never self-replanned
+        seed=SMOKE_SEED,
+    )
+
+
+def _smoke_requests(pool, count: int):
+    """The canonical request stream: identical in every process."""
+    from multihop_offload_tpu.serve.workload import request_stream
+
+    return list(request_stream(pool, count, seed=SMOKE_SEED))
+
+
+def _digest(resp) -> str:
+    """Decision identity: destination nodes + local/offload flags + which
+    path answered.  Float delay estimates are deliberately excluded — the
+    DECISION is the contract; sharded reductions may re-associate float
+    low bits without changing any placement."""
+    import numpy as np
+
+    h = hashlib.sha256()
+    h.update(np.ascontiguousarray(resp.dst).tobytes())
+    h.update(np.ascontiguousarray(resp.is_local).tobytes())
+    h.update(resp.served_by.encode())
+    return h.hexdigest()[:16]
+
+
+def _serve_window(service, requests, indices, clock,
+                  tick_s: float = TICK_S) -> Dict[str, object]:
+    """Submit `requests[i] for i in indices` on the virtual clock, tick to
+    completion, return per-request digests + accounting."""
+    admitted = 0
+    responses = []
+    t = clock.now()
+    for i in indices:
+        t += 0.005
+        clock.seek(t)
+        if service.submit(requests[i], now=t):
+            admitted += 1
+    for _ in range(2000):
+        if len(responses) >= admitted:
+            break
+        t += tick_s
+        clock.seek(t)
+        responses.extend(service.tick(now=t))
+    return {
+        "offered": len(indices),
+        "admitted": admitted,
+        "served": len(responses),
+        "degraded": sum(1 for r in responses if r.served_by != "gnn"),
+        "digests": {str(r.request_id): _digest(r) for r in responses},
+    }
+
+
+# --------------------------------------------------------------------------
+# worker
+# --------------------------------------------------------------------------
+
+def _send(obj: dict) -> None:
+    print(PREFIX + json.dumps(obj), flush=True)
+
+
+def run_worker() -> int:
+    """One mesh process: bootstrap the group, serve owned buckets on local
+    devices, answer the coordinator's protocol commands."""
+    from multihop_offload_tpu.cli.serve import build_service
+    from multihop_offload_tpu.loadgen.driver import VirtualClock
+    from multihop_offload_tpu.multihost.federation import MetricsEndpoint
+    from multihop_offload_tpu.multihost.plan import (
+        TwoLevelPlan, local_placement,
+    )
+    from multihop_offload_tpu.multihost.runtime import bootstrap
+    from multihop_offload_tpu.obs import jaxhooks
+
+    jaxhooks.install()
+    rt = bootstrap(timeout_s=60.0)
+    clock = VirtualClock()
+    cfg = _smoke_config()
+    service, pool = build_service(cfg, clock=clock,
+                                  devices=rt.local_devices(),
+                                  load_checkpoint=False)
+    requests = None  # built lazily: the pool is cheap, requests less so
+    endpoint = MetricsEndpoint()
+    _send({"event": "ready", "host": rt.host,
+           "process_id": rt.process_id,
+           "num_processes": rt.num_processes,
+           "metrics_url": endpoint.url,
+           "local_devices": [d.id for d in rt.local_devices()],
+           "global_devices": len(__import__("jax").devices()),
+           "pid": os.getpid()})
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        cmd = json.loads(line)
+        if cmd["cmd"] == "place":
+            desc = cmd["plan"]
+            n = len(desc)
+            plan = TwoLevelPlan(
+                hosts=tuple(desc[str(b)]["host"] for b in range(n)),
+                devices=tuple(tuple(desc[str(b)]["devices"])
+                              for b in range(n)),
+            )
+            local = local_placement(plan, rt.host, rt.local_devices())
+            service.executor.set_placement(local)
+            _send({"event": "placed", "host": rt.host,
+                   "owned": plan.buckets_on_host(rt.host)})
+        elif cmd["cmd"] == "serve":
+            if requests is None or len(requests) < int(cmd["total"]):
+                requests = _smoke_requests(pool, int(cmd["total"]))
+            out = _serve_window(service, requests, cmd["indices"], clock)
+            # steady from the end of the FIRST window: warmup compiles
+            # (utility-op jits, first bucket programs) are ordinary; from
+            # here on only expected_rebuild scopes may trace
+            jaxhooks.mark_steady()
+            out.update({"event": "served", "host": rt.host,
+                        "unexpected_retraces": jaxhooks.unexpected_retraces()})
+            _send(out)
+        elif cmd["cmd"] == "stop":
+            _send({"event": "bye", "host": rt.host})
+            break
+    endpoint.close()
+    return 0
+
+
+# --------------------------------------------------------------------------
+# coordinator
+# --------------------------------------------------------------------------
+
+class _Worker:
+    """One spawned worker: process handle + a reader thread that filters
+    protocol lines into a queue (so a slow/chatty worker can never block
+    or corrupt the coordinator)."""
+
+    def __init__(self, proc: subprocess.Popen):
+        self.proc = proc
+        self.lines: "queue.Queue[dict]" = queue.Queue()
+        self.stderr_tail: List[str] = []
+        threading.Thread(target=self._read_stdout, daemon=True).start()
+        threading.Thread(target=self._read_stderr, daemon=True).start()
+
+    def _read_stdout(self):
+        for line in self.proc.stdout:
+            if line.startswith(PREFIX):
+                try:
+                    self.lines.put(json.loads(line[len(PREFIX):]))
+                except json.JSONDecodeError:
+                    pass
+
+    def _read_stderr(self):
+        for line in self.proc.stderr:
+            self.stderr_tail.append(line.rstrip())
+            del self.stderr_tail[:-40]
+
+    def recv(self, timeout_s: float) -> dict:
+        try:
+            return self.lines.get(timeout=timeout_s)
+        except queue.Empty:
+            tail = "\n".join(self.stderr_tail[-12:])
+            raise TimeoutError(
+                f"worker pid {self.proc.pid} silent for {timeout_s}s; "
+                f"stderr tail:\n{tail}"
+            )
+
+    def send(self, obj: dict) -> None:
+        self.proc.stdin.write(json.dumps(obj) + "\n")
+        self.proc.stdin.flush()
+
+    def kill_hard(self) -> None:
+        self.proc.send_signal(signal.SIGKILL)
+        self.proc.wait(timeout=10)
+
+    def stop(self) -> None:
+        if self.proc.poll() is None:
+            try:
+                self.send({"cmd": "stop"})
+                self.proc.wait(timeout=10)
+            except Exception:
+                self.proc.kill()
+
+
+def _spawn_workers(num_hosts: int, local_devices: int) -> List[_Worker]:
+    from multihop_offload_tpu.multihost.runtime import free_port, worker_env
+
+    coordinator = f"127.0.0.1:{free_port()}"
+    workers = []
+    for pid in range(num_hosts):
+        env = worker_env(coordinator, num_hosts, pid,
+                         local_devices=local_devices)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "multihop_offload_tpu.cli.mesh",
+             "--worker"],
+            env=env, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True,
+        )
+        workers.append(_Worker(proc))
+    return workers
+
+
+def _check(record: dict, name: str, ok: bool, detail: str = "") -> bool:
+    record["checks"][name] = {"ok": bool(ok), **({"detail": detail} if detail else {})}
+    print(f"  [{'ok' if ok else 'FAIL'}] {name}" + (f" — {detail}" if detail else ""))
+    return bool(ok)
+
+
+def run_smoke(out_path: str) -> int:
+    from multihop_offload_tpu.cli.serve import build_service
+    from multihop_offload_tpu.loadgen import (
+        VirtualClock, arrival_times, max_sustained_rate, poisson,
+        run_open_loop,
+    )
+    from multihop_offload_tpu.multihost.federation import FleetFederation
+    from multihop_offload_tpu.multihost.plan import TwoLevelPlanner
+
+    t_wall = time.monotonic()
+    record: dict = {
+        "schema": 1,
+        "mode": "cpu_two_local_processes",
+        "hosts": SMOKE_HOSTS,
+        "local_devices_per_host": SMOKE_LOCAL_DEVICES,
+        "checks": {},
+    }
+    ok = True
+    workers: List[_Worker] = []
+    try:
+        # --- bring-up ---------------------------------------------------
+        print(f"mesh smoke: spawning {SMOKE_HOSTS} workers "
+              f"({SMOKE_LOCAL_DEVICES} virtual devices each)...")
+        workers = _spawn_workers(SMOKE_HOSTS, SMOKE_LOCAL_DEVICES)
+        ready = [w.recv(timeout_s=120.0) for w in workers]
+        by_host = {r["host"]: w for r, w in zip(ready, workers)}
+        host_table = {r["host"]: r["local_devices"] for r in ready}
+        record["bring_up"] = {r["host"]: r for r in ready}
+        ok &= _check(
+            record, "process_group_formed",
+            all(r["num_processes"] == SMOKE_HOSTS for r in ready)
+            and all(r["global_devices"]
+                    == SMOKE_HOSTS * SMOKE_LOCAL_DEVICES for r in ready),
+            f"{len(ready)} processes, "
+            f"{ready[0]['global_devices']} global devices",
+        )
+
+        # --- two-level placement ----------------------------------------
+        cfg = _smoke_config()
+        planner = TwoLevelPlanner(cfg.serve_buckets, host_table,
+                                  cfg.serve_slots)
+        planner.observe([3.0, 2.0])   # distinct rates: deterministic split
+        plan = planner.replan()
+        record["plan"] = plan.describe()
+        hosts_used = set(plan.hosts)
+        ok &= _check(record, "plan_spans_hosts", len(hosts_used) > 1,
+                     f"buckets over hosts {sorted(hosts_used)}")
+        for w in workers:
+            w.send({"cmd": "place", "plan": plan.describe()})
+        for w in workers:
+            w.recv(timeout_s=60.0)
+
+        # --- single-host reference (this process, one device) -----------
+        print("building single-host reference service...")
+        clock = VirtualClock()
+        ref_service, pool = build_service(cfg, clock=clock,
+                                          load_checkpoint=False)
+        total = WINDOW_1 + WINDOW_2
+        requests = _smoke_requests(pool, total)
+        ref_w1 = _serve_window(ref_service, requests,
+                               list(range(WINDOW_1)), clock)
+        bucket_of = {
+            i: ref_service.buckets.bucket_for(*requests[i].sizes)
+            for i in range(total)
+        }
+
+        # --- window 1: both hosts serve their owned buckets -------------
+        owned = {
+            h: [i for i in range(WINDOW_1)
+                if plan.host_of(bucket_of[i]) == h]
+            for h in host_table
+        }
+        replies = {}
+        for h, w in by_host.items():
+            w.send({"cmd": "serve", "indices": owned[h], "total": total})
+        for h, w in by_host.items():
+            replies[h] = w.recv(timeout_s=120.0)
+        record["window_1"] = {
+            h: {k: r[k] for k in
+                ("offered", "admitted", "served", "degraded",
+                 "unexpected_retraces")}
+            for h, r in replies.items()
+        }
+        served_hosts = [h for h, r in replies.items() if r["served"] > 0]
+        ok &= _check(record, "multi_process_served",
+                     len(served_hosts) > 1,
+                     f"hosts serving: {sorted(served_hosts)}")
+        mesh_digests = {}
+        for r in replies.values():
+            mesh_digests.update(r["digests"])
+        mismatch = [i for i in map(str, range(WINDOW_1))
+                    if mesh_digests.get(i) != ref_w1["digests"].get(i)]
+        ok &= _check(record, "decisions_bit_identical_w1", not mismatch,
+                     f"{WINDOW_1 - len(mismatch)}/{WINDOW_1} digests match")
+        ok &= _check(
+            record, "conservation_w1",
+            sum(r["served"] for r in replies.values()) == WINDOW_1
+            and ref_w1["served"] == WINDOW_1,
+            f"mesh {sum(r['served'] for r in replies.values())}"
+            f"/{WINDOW_1}, ref {ref_w1['served']}/{WINDOW_1}",
+        )
+
+        # --- federation: fleet-wide host-labeled series ------------------
+        fed = FleetFederation(
+            {r["host"]: r["metrics_url"] for r in ready})
+        fed.scrape()
+        served_by_host = {
+            h: fed.registry.counter("mho_serve_served_total").total(host=h)
+            for h in host_table
+        }
+        record["federation"] = {"served_by_host": served_by_host}
+        ok &= _check(
+            record, "federated_counters_span_hosts",
+            sum(1 for v in served_by_host.values() if v > 0) > 1,
+            f"mho_serve_served_total by host: {served_by_host}",
+        )
+
+        # --- kill a whole host ------------------------------------------
+        victim = max(host_table)          # never process 0: it hosts the
+        survivor_hosts = sorted(set(host_table) - {victim})  # coord service
+        print(f"killing {victim} (SIGKILL), replanning onto "
+              f"{survivor_hosts}...")
+        by_host[victim].kill_hard()
+        plan2 = planner.remove_host(victim)
+        record["plan_after_loss"] = plan2.describe()
+        ok &= _check(
+            record, "forced_replan_excludes_victim",
+            victim not in set(plan2.hosts),
+            f"buckets now on {sorted(set(plan2.hosts))}",
+        )
+        scrape2 = fed.scrape()
+        up_victim = fed.registry.gauge("mho_mesh_host_up").value(host=victim)
+        ok &= _check(
+            record, "federation_marks_victim_down",
+            scrape2.get(victim) is False and up_victim == 0.0,
+            f"host_up{{{victim}}}={up_victim}",
+        )
+        for h in survivor_hosts:
+            by_host[h].send({"cmd": "place", "plan": plan2.describe()})
+        for h in survivor_hosts:
+            by_host[h].recv(timeout_s=60.0)
+        w2_ids = list(range(WINDOW_1, total))
+        ref_w2 = _serve_window(ref_service, requests, w2_ids, clock)
+        owned2 = {
+            h: [i for i in w2_ids if plan2.host_of(bucket_of[i]) == h]
+            for h in survivor_hosts
+        }
+        replies2 = {}
+        for h in survivor_hosts:
+            by_host[h].send({"cmd": "serve", "indices": owned2[h],
+                             "total": total})
+        for h in survivor_hosts:
+            replies2[h] = by_host[h].recv(timeout_s=120.0)
+        record["window_2"] = {
+            h: {k: r[k] for k in
+                ("offered", "admitted", "served", "degraded",
+                 "unexpected_retraces")}
+            for h, r in replies2.items()
+        }
+        mesh2 = {}
+        for r in replies2.values():
+            mesh2.update(r["digests"])
+        mismatch2 = [str(i) for i in w2_ids
+                     if mesh2.get(str(i)) != ref_w2["digests"].get(str(i))]
+        ok &= _check(record, "decisions_bit_identical_after_takeover",
+                     not mismatch2,
+                     f"{len(w2_ids) - len(mismatch2)}/{len(w2_ids)} "
+                     "digests match")
+        ok &= _check(
+            record, "conservation_after_takeover",
+            sum(r["served"] for r in replies2.values()) == WINDOW_2,
+            f"{sum(r['served'] for r in replies2.values())}/{WINDOW_2} "
+            "served by survivors",
+        )
+        retraces = {h: r["unexpected_retraces"]
+                    for h, r in replies2.items()}
+        ok &= _check(
+            record, "zero_unexpected_retraces_after_recovery",
+            all(v == 0 for v in retraces.values()),
+            f"unexpected retraces by survivor: {retraces}",
+        )
+
+        # --- open-loop sustained-rate bisection -------------------------
+        print("open-loop bisection for sustained req/s at p99 "
+              f"<= {OPEN_LOOP_SLO_P99_S}s...")
+
+        def probe(rate: float):
+            span = OPEN_LOOP_N / rate
+            ats = arrival_times(poisson(rate), span, seed=SMOKE_SEED)
+            reqs = _smoke_requests(pool, len(ats))
+            return run_open_loop(ref_service, reqs, ats, clock=clock,
+                                 tick_interval_s=TICK_S, duration_s=span)
+
+        result = max_sustained_rate(
+            probe, lo_rps=10.0, p99_slo_s=OPEN_LOOP_SLO_P99_S,
+            max_drop_fraction=0.01, iters=4, max_doublings=5,
+        )
+        record["open_loop"] = result.to_json()
+        record["open_loop"]["note"] = (
+            "per-host sustained rate on the reference service, virtual "
+            "clock: capacity is structural (slots x buckets / tick), not "
+            "host speed")
+        finite = (result.sustained_rps > 0
+                  and result.sustained_rps == result.sustained_rps)
+        ok &= _check(
+            record, "open_loop_sustained_finite", finite,
+            f"sustained {result.sustained_rps:.1f} req/s at p99 <= "
+            f"{OPEN_LOOP_SLO_P99_S}s ({len(result.probes)} probes)",
+        )
+    finally:
+        for w in workers:
+            try:
+                w.stop()
+            except Exception:
+                pass
+
+    record["elapsed_s"] = round(time.monotonic() - t_wall, 2)
+    record["pass"] = bool(ok)
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(record, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"mesh smoke: {'PASS' if ok else 'FAIL'} in "
+          f"{record['elapsed_s']}s -> {out_path}")
+    return 0 if ok else 1
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mho-mesh", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--smoke", action="store_true",
+                    help="two-local-process CPU mesh drill (<90s)")
+    ap.add_argument("--worker", action="store_true",
+                    help="internal: run as a spawned mesh worker")
+    ap.add_argument("--out", default=DEFAULT_OUT,
+                    help=f"smoke record path (default {DEFAULT_OUT})")
+    args = ap.parse_args(argv)
+    if args.worker:
+        return run_worker()
+    if args.smoke:
+        return run_smoke(args.out)
+    ap.error("nothing to do: pass --smoke (or --worker, internal)")
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -58,11 +58,21 @@ def resolve_serve_devices(cfg: Config):
     return list(devs[:mesh])
 
 
-def build_service(cfg: Config, pool=None, clock=None):
+def build_service(cfg: Config, pool=None, clock=None, devices=None,
+                  load_checkpoint=True):
     """Construct (service, pool) from config — shared by this CLI, the load
     generator, and the smoke tests so every entry point wires the same way.
     `clock` overrides the service's time source (the health smoke drives a
-    manual clock through injected latency bursts)."""
+    manual clock through injected latency bursts).  `devices` overrides the
+    config-resolved serving fleet outright — mesh workers pass
+    `jax.local_devices()` because under `jax.distributed` the config path
+    would resolve against the GLOBAL device list and try to place onto
+    chips this process cannot address.  `load_checkpoint=False` skips the
+    orbax hot-load and serves the seeded fresh-init weights — the mesh
+    smoke needs it because orbax's CheckpointManager runs a cross-process
+    sync collective, which the CPU backend does not implement; seeded init
+    is already identical across processes (weight replication by
+    construction)."""
     import jax
     import jax.numpy as jnp
 
@@ -93,7 +103,7 @@ def build_service(cfg: Config, pool=None, clock=None):
         dtype=cfg.jnp_dtype, precision=cfg.precision_policy,
         capture_sample=cfg.loop_capture_sample,
         trace=getattr(cfg, "obs_trace", True),
-        mesh_devices=resolve_serve_devices(cfg),
+        mesh_devices=devices if devices is not None else resolve_serve_devices(cfg),
         replan_every=max(1, int(getattr(cfg, "serve_replan_ticks", 16))),
         **({"clock": clock} if clock is not None else {}),
     )
@@ -108,7 +118,7 @@ def build_service(cfg: Config, pool=None, clock=None):
             recorder=recorder,
             flight_dir=cfg.model_dir(),
         ))
-    loaded = service.hot_reload(cfg.model_dir())
+    loaded = service.hot_reload(cfg.model_dir()) if load_checkpoint else None
     print("serving with "
           + (f"checkpoint step {loaded} from {cfg.model_dir()}"
              if loaded is not None else "fresh-init weights (no checkpoint)"))

@@ -1,0 +1,57 @@
+"""Multi-host mesh federation: crossing the host boundary.
+
+Three layers, each usable alone:
+
+  * `multihost.runtime`    — `jax.distributed` process-group bootstrap with
+    coordinator retry/timeout/backoff, plus the CPU-provable two-local-
+    process mode over `XLA_FLAGS=--xla_force_host_platform_device_count`
+    virtual devices (`mho-mesh --smoke`);
+  * `multihost.plan`       — the two-level DCN-aware placement planner:
+    buckets -> hosts (level 1, weights replicated per host), bucket batch
+    axes -> chips within the host (level 2, delegated to the existing
+    `serve.placement` divisor ladder).  A bucket NEVER spans the DCN
+    boundary; same EWMA/hysteresis contract as the single-host planner;
+  * `multihost.federation` — cross-process metric/SLO federation: every
+    process keeps its existing Prometheus text exposition, a coordinator-
+    side scraper merges the registries under `host=` labels so burn-rate
+    SLOs and the flight recorder see fleet-wide series.
+
+Serving decisions are bit-identical to the single-host path under ANY
+placement: the per-bucket compiled closures are reused untouched — the
+host boundary only moves WHERE a bucket's program runs, never what it
+computes (request decisions are PRNG-keyed by request id).
+"""
+
+from multihop_offload_tpu.multihost.federation import (  # noqa: F401
+    FleetFederation,
+    MetricsEndpoint,
+    federated_slo_engine,
+    parse_prometheus_text,
+)
+from multihop_offload_tpu.multihost.plan import (  # noqa: F401
+    TwoLevelPlan,
+    TwoLevelPlanner,
+    local_placement,
+    plan_two_level,
+    validate_plan,
+)
+from multihop_offload_tpu.multihost.runtime import (  # noqa: F401
+    MeshRuntime,
+    bootstrap,
+    init_distributed,
+)
+
+__all__ = [
+    "FleetFederation",
+    "MetricsEndpoint",
+    "federated_slo_engine",
+    "parse_prometheus_text",
+    "TwoLevelPlan",
+    "TwoLevelPlanner",
+    "local_placement",
+    "plan_two_level",
+    "validate_plan",
+    "MeshRuntime",
+    "bootstrap",
+    "init_distributed",
+]

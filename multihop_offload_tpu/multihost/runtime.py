@@ -1,0 +1,285 @@
+"""`jax.distributed` process-group bring-up, owned in one place.
+
+All `jax.distributed.initialize` / `jax.process_index` calls for the repo
+live in this module (lint rule JX010 keeps it that way): scattering
+process-group bring-up across entry points is how a fleet ends up with n
+independent single-process runs that LOOK like a cluster.
+
+Two entry points:
+
+  * `init_distributed` — env-hint autodetection (GKE/Slurm/TPU-pod
+    metadata), moved here verbatim from `parallel.mesh` which re-exports
+    it.  Single-process runs no-op; a named coordinator that fails stays
+    an error.
+  * `bootstrap` — the serving path: explicit coordinator/process identity
+    (args or `MHO_MESH_*` env), retry with exponential backoff until a
+    deadline (workers routinely start before their coordinator binds),
+    and a `MeshRuntime` handle that names this process's host and can
+    tabulate every host's chips for the two-level planner.
+
+The CPU-provable mode is nothing special: two local processes over
+`XLA_FLAGS=--xla_force_host_platform_device_count=N` virtual devices form
+a real `jax.distributed` group on localhost (`free_port` + `worker_env`
+build the child environment; `mho-mesh --smoke` drives it end to end).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import socket
+import time
+from typing import Dict, List, Optional
+
+import jax
+
+from multihop_offload_tpu.obs import events as obs_events
+from multihop_offload_tpu.obs.registry import registry as obs_registry
+
+# env carrying explicit process identity into `bootstrap` (worker_env sets
+# these for smoke-mode children; a launcher can set them for real fleets)
+ENV_COORDINATOR = "MHO_MESH_COORDINATOR"
+ENV_NUM_PROCESSES = "MHO_MESH_NUM_PROCESSES"
+ENV_PROCESS_ID = "MHO_MESH_PROCESS_ID"
+
+_initialized = False  # jax.distributed.initialize is once-per-process
+
+
+def host_name(process_index: int) -> str:
+    """The canonical host id for a process index — the `host=` label value
+    in federated metrics and the host key in two-level plans."""
+    return f"host{int(process_index)}"
+
+
+def free_port() -> int:
+    """An OS-assigned localhost port for a smoke-mode coordinator."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def worker_env(
+    coordinator: str,
+    num_processes: int,
+    process_id: int,
+    local_devices: int = 2,
+    base_env: Optional[Dict[str, str]] = None,
+) -> Dict[str, str]:
+    """The child environment for one CPU smoke-mode worker process.
+
+    `XLA_FLAGS` must be in the environment BEFORE the child imports jax —
+    that is why smoke mode spawns subprocesses instead of threads: the
+    virtual-device count is a backend-init-time setting."""
+    env = dict(os.environ if base_env is None else base_env)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={int(local_devices)}"
+    )
+    env[ENV_COORDINATOR] = coordinator
+    env[ENV_NUM_PROCESSES] = str(int(num_processes))
+    env[ENV_PROCESS_ID] = str(int(process_id))
+    return env
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshRuntime:
+    """One process's view of the formed group."""
+
+    process_id: int
+    num_processes: int
+    coordinator_address: Optional[str]
+
+    @property
+    def host(self) -> str:
+        return host_name(self.process_id)
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.process_id == 0
+
+    def local_devices(self) -> List:
+        """The devices THIS process may place computations on.  Under
+        `jax.distributed`, `jax.devices()` is the global fleet — placing
+        onto a non-addressable device is an error, so serving always
+        builds from the local list."""
+        return list(jax.local_devices())
+
+    def host_table(self) -> Dict[str, List[int]]:
+        """Every host's chips as global device ids, grouped by owning
+        process — identical on every process of the group (it is read off
+        the shared global device list), which is what lets each process
+        derive the same two-level plan with zero coordination traffic."""
+        table: Dict[str, List[int]] = {}
+        for d in jax.devices():
+            table.setdefault(host_name(d.process_index), []).append(d.id)
+        return {h: sorted(ids) for h, ids in sorted(table.items())}
+
+    def describe(self) -> dict:
+        return {
+            "host": self.host,
+            "process_id": self.process_id,
+            "num_processes": self.num_processes,
+            "coordinator": self.coordinator_address,
+            "local_devices": [d.id for d in self.local_devices()],
+            "global_devices": len(jax.devices()),
+        }
+
+
+def _env_int(name: str) -> Optional[int]:
+    raw = os.environ.get(name, "").strip()
+    return int(raw) if raw else None
+
+
+def bootstrap(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    *,
+    timeout_s: float = 60.0,
+    backoff_s: float = 0.25,
+    max_backoff_s: float = 2.0,
+) -> MeshRuntime:
+    """Join (or be) the process group, retrying until `timeout_s`.
+
+    Identity comes from the explicit args, else the `MHO_MESH_*` env set
+    by `worker_env` / a launcher.  With neither (or a group of one) this
+    is a single-process runtime — no coordination service is started, the
+    returned handle just says so.
+
+    Workers starting before their coordinator binds are the NORMAL case,
+    not an error: each failed attempt backs off exponentially (counted in
+    `mho_mesh_bootstrap_retries_total`) until the deadline, and only a
+    coordinator still unreachable at the deadline raises."""
+    global _initialized
+    coordinator_address = coordinator_address or os.environ.get(
+        ENV_COORDINATOR) or None
+    if num_processes is None:
+        num_processes = _env_int(ENV_NUM_PROCESSES)
+    if process_id is None:
+        process_id = _env_int(ENV_PROCESS_ID)
+
+    if coordinator_address is None or (num_processes or 1) <= 1:
+        rt = MeshRuntime(process_id=0, num_processes=1,
+                         coordinator_address=None)
+        obs_events.emit("mesh_bootstrap", **rt.describe(), attempts=0)
+        return rt
+
+    if _initialized:
+        # initialize() is once-per-process; a second bootstrap just
+        # re-reads the already-formed group
+        rt = MeshRuntime(process_id=jax.process_index(),
+                         num_processes=jax.process_count(),
+                         coordinator_address=coordinator_address)
+        return rt
+
+    retries = obs_registry().counter(
+        "mho_mesh_bootstrap_retries_total",
+        "failed jax.distributed bring-up attempts before success",
+    )
+    deadline = time.monotonic() + float(timeout_s)  # nondet-ok(bring-up deadline is real wall time: the coordinator is an external process)
+    delay = float(backoff_s)
+    attempt = 0
+    while True:
+        attempt += 1
+        remaining = deadline - time.monotonic()  # nondet-ok(same wall-clock deadline)
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+                initialization_timeout=max(1, int(remaining)),
+            )
+            break
+        except Exception as exc:
+            if time.monotonic() + delay >= deadline:  # nondet-ok(same wall-clock deadline)
+                raise RuntimeError(
+                    f"mesh bootstrap: coordinator {coordinator_address} "
+                    f"unreachable after {attempt} attempt(s) over "
+                    f"{timeout_s:.0f}s"
+                ) from exc
+            retries.inc()
+            time.sleep(delay)
+            delay = min(delay * 2.0, float(max_backoff_s))
+    _initialized = True
+    rt = MeshRuntime(
+        process_id=jax.process_index(),
+        num_processes=jax.process_count(),
+        coordinator_address=coordinator_address,
+    )
+    obs_events.emit("mesh_bootstrap", **rt.describe(), attempts=attempt)
+    return rt
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> int:
+    """Multi-host bring-up: join the JAX distributed runtime so
+    `jax.devices()` spans every host and `make_mesh` lays the `data` axis
+    across DCN while `graph` stays on-host ICI.
+
+    The reference has no distributed backend at all (SURVEY.md §5.8) — this
+    is the framework's NCCL/MPI-equivalent entry point, built on JAX's own
+    coordination service.  Explicit args win; otherwise standard cluster env
+    detection (GKE/Slurm/TPU pod metadata) applies; single-process runs
+    no-op.  Returns this process's index.
+    """
+    global _initialized
+    if any(a is not None for a in (coordinator_address, num_processes, process_id)):
+        # any explicit arg selects the explicit path; incomplete sets are
+        # jax.distributed's own error to raise, not ours to mask
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        _initialized = True
+        return jax.process_index()
+    # strong hints name a coordinator outright; weak hints suggest a
+    # scheduler/pod context, but only count when they actually imply more
+    # than one process — axon hosts export TPU_WORKER_HOSTNAMES=localhost
+    # (one entry) on plain single-process runs, and a 1-task SLURM
+    # allocation is not a cluster either
+    strong_hints = (
+        "COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS",
+        "MEGASCALE_COORDINATOR_ADDRESS",
+    )
+    has_strong = any(h in os.environ for h in strong_hints)
+
+    def _weak_multiprocess() -> bool:
+        def as_int(name):
+            try:
+                return int(os.environ.get(name, ""))
+            except ValueError:
+                return 0
+
+        hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+        n_hosts = len([h for h in hosts.split(",") if h.strip()])
+        return (
+            n_hosts > 1
+            or as_int("OMPI_COMM_WORLD_SIZE") > 1
+            or ("SLURM_JOB_ID" in os.environ
+                and max(as_int("SLURM_NTASKS"), as_int("SLURM_NPROCS")) > 1)
+            # Cloud TPU pods export a task id; jax auto-detects the rest
+            # from TPU metadata, so its presence alone warrants an attempt
+            or "CLOUD_TPU_TASK_ID" in os.environ
+        )
+
+    if not has_strong and not _weak_multiprocess():
+        return 0  # genuinely single-process: no multi-process context
+    try:
+        jax.distributed.initialize()
+    except ValueError:
+        if not has_strong:
+            # auto-detection could not assemble a cluster spec from weak
+            # hints alone — "no cluster", not a failed bring-up (no
+            # exception-text parsing: ValueError is jax.distributed's
+            # incomplete-spec signal; RuntimeErrors still propagate)
+            return 0
+        raise  # a named coordinator that fails to resolve IS misconfiguration
+    # real bring-up failures (RuntimeError: coordinator unreachable, RPC
+    # errors) propagate — never silently degrade a configured cluster into
+    # n independent single-process runs
+    _initialized = True
+    return jax.process_index()

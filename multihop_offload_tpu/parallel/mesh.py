@@ -68,75 +68,7 @@ def global_batch(mesh: Mesh, tree, axis: str = "data"):
     return jax.tree_util.tree_map(put, tree)
 
 
-def init_distributed(
-    coordinator_address: Optional[str] = None,
-    num_processes: Optional[int] = None,
-    process_id: Optional[int] = None,
-) -> int:
-    """Multi-host bring-up: join the JAX distributed runtime so
-    `jax.devices()` spans every host and `make_mesh` lays the `data` axis
-    across DCN while `graph` stays on-host ICI.
-
-    The reference has no distributed backend at all (SURVEY.md §5.8) — this
-    is the framework's NCCL/MPI-equivalent entry point, built on JAX's own
-    coordination service.  Explicit args win; otherwise standard cluster env
-    detection (GKE/Slurm/TPU pod metadata) applies; single-process runs
-    no-op.  Returns this process's index.
-    """
-    import os
-
-    if any(a is not None for a in (coordinator_address, num_processes, process_id)):
-        # any explicit arg selects the explicit path; incomplete sets are
-        # jax.distributed's own error to raise, not ours to mask
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-        )
-        return jax.process_index()
-    # strong hints name a coordinator outright; weak hints suggest a
-    # scheduler/pod context, but only count when they actually imply more
-    # than one process — axon hosts export TPU_WORKER_HOSTNAMES=localhost
-    # (one entry) on plain single-process runs, and a 1-task SLURM
-    # allocation is not a cluster either
-    strong_hints = (
-        "COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS",
-        "MEGASCALE_COORDINATOR_ADDRESS",
-    )
-    has_strong = any(h in os.environ for h in strong_hints)
-
-    def _weak_multiprocess() -> bool:
-        def as_int(name):
-            try:
-                return int(os.environ.get(name, ""))
-            except ValueError:
-                return 0
-
-        hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
-        n_hosts = len([h for h in hosts.split(",") if h.strip()])
-        return (
-            n_hosts > 1
-            or as_int("OMPI_COMM_WORLD_SIZE") > 1
-            or ("SLURM_JOB_ID" in os.environ
-                and max(as_int("SLURM_NTASKS"), as_int("SLURM_NPROCS")) > 1)
-            # Cloud TPU pods export a task id; jax auto-detects the rest
-            # from TPU metadata, so its presence alone warrants an attempt
-            or "CLOUD_TPU_TASK_ID" in os.environ
-        )
-
-    if not has_strong and not _weak_multiprocess():
-        return 0  # genuinely single-process: no multi-process context
-    try:
-        jax.distributed.initialize()
-    except ValueError:
-        if not has_strong:
-            # auto-detection could not assemble a cluster spec from weak
-            # hints alone — "no cluster", not a failed bring-up (no
-            # exception-text parsing: ValueError is jax.distributed's
-            # incomplete-spec signal; RuntimeErrors still propagate)
-            return 0
-        raise  # a named coordinator that fails to resolve IS misconfiguration
-    # real bring-up failures (RuntimeError: coordinator unreachable, RPC
-    # errors) propagate — never silently degrade a configured cluster into
-    # n independent single-process runs
-    return jax.process_index()
+# Process-group bring-up moved to `multihost.runtime` (lint rule JX010
+# keeps every jax.distributed call there); re-exported for existing
+# callers of parallel.mesh.init_distributed.
+from multihop_offload_tpu.multihost.runtime import init_distributed  # noqa: F401,E402

@@ -164,7 +164,7 @@ class _Harness:
         self.opt_state = self.optimizer.init(self.variables["params"])
         # multi-host runs share a filesystem: only process 0 writes CSVs,
         # checkpoints, and TB events
-        self.is_host0 = jax.process_index() == 0
+        self.is_host0 = jax.process_index() == 0  # mesh-ok(host0-only artifact writes; bring-up itself is multihost.runtime's)
         # data-parallel mesh (SURVEY.md §2.8): with >1 device the Trainer
         # shards the per-file episode batch and the Evaluator shards files
         # over the 'data' axis; mesh_data=0 means "all local devices" —

@@ -12,7 +12,9 @@
 #   2. the repo-specific JAX-aware rules — `mho-lint` (the AST engine in
 #      multihop_offload_tpu/analysis/): JX001 trace-safety, JX002 retrace
 #      hazards, JX003 dtype pinning, JX004 hot-loop host sync, JX005
-#      nondeterminism, plus MP001 (precision), SL001 (layout), OB001
+#      nondeterminism, through JX010 mesh bring-up ownership (the full
+#      roster: `mho-lint --list-rules`), plus MP001 (precision), SL001
+#      (layout), OB001
 #      (prints) — the three rules the old regex fallback carried, now
 #      alias- and multi-line-aware.  Waive deliberate sites per line with
 #      the rule's token (see `mho-lint --list-rules` or

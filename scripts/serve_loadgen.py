@@ -1,11 +1,19 @@
-"""Closed-loop load generator for the serving subsystem — the committed
+"""Load generator for the serving subsystem — the committed
 throughput/latency record.
 
-Drives `serve.OffloadService` with a synthetic traffic pool at full
-admission pressure (the queue is kept at capacity; every tick drains full
-batches), measures decisions/sec, p50/p99 latency, per-bucket occupancy and
-padding waste, and dispatches per request — the number the subsystem exists
-to attack.  Two legs share one compiled service:
+The HEADLINE (`open_loop` block) is the honest serving figure: max
+sustained req/s at a fixed p99 time-in-system SLO, found by bisection over
+offered rate (`loadgen.search`), where each probe injects seeded Poisson
+arrivals open-loop on a virtual clock (`loadgen.driver`) — overload shows
+up as drops and p99 blow-up instead of generator back-off, and the virtual
+clock makes the number structural (slots x buckets per tick interval), not
+host-speed-dependent.  A second run at 80% of the sustained rate with
+MMPP bursts + a diurnal sweep + a flash crowd shows the margin under
+non-stationary traffic.
+
+The `legacy` block keeps the original closed-loop record (queue held at
+capacity, generator retries refused submits) for continuity with earlier
+commits.  Two legs share one compiled service:
 
   * `gnn` — the policy path (deadline set high so nothing degrades);
   * `degraded` — deadline 0 forces every batch onto the analytic greedy
@@ -73,6 +81,101 @@ def run_leg(service, pool, requests, seed, arrival_scale, deadline_s):
     return summary
 
 
+def run_open_loop_record(pool, args, build_service, Config):
+    """The open-loop headline: bisect for max sustained req/s at the p99
+    SLO, then characterize margin at 80% of it under bursty traffic.
+    Runs on a dedicated service driven by a virtual clock."""
+    from multihop_offload_tpu.loadgen import (
+        TrafficModel,
+        VirtualClock,
+        arrival_times,
+        max_sustained_rate,
+        run_open_loop,
+    )
+    from multihop_offload_tpu.serve.workload import request_stream
+
+    slo_s = args.p99_slo_ms / 1e3
+    tick_s = args.tick_interval_ms / 1e3
+    clock = VirtualClock()
+    cfg = Config(
+        serve_slots=args.slots, serve_queue_cap=args.queue_cap,
+        serve_buckets=args.buckets, serve_sizes=args.sizes,
+        seed=args.seed, dtype="float32",
+        serve_deadline_s=slo_s,  # the service's own degradation budget = SLO
+        model_root=os.path.join(REPO, "model"),
+    )
+    service, _ = build_service(cfg, pool=pool, clock=clock)
+    # warm-up: compile every (bucket, path) program outside the probes
+    for req in request_stream(pool, len(pool) * 2, seed=args.seed + 96,
+                              id_offset=4_000_000_000):
+        service.submit(req, now=clock.now())
+    while service.queue_depth:
+        clock.advance(tick_s)
+        service.tick(now=clock.now())
+
+    probe_i = [0]
+
+    def probe(rate):
+        i = probe_i[0]
+        probe_i[0] += 1
+        duration = args.open_loop_requests / rate
+        arr = arrival_times(TrafficModel(base_rate=rate), duration,
+                            seed=args.seed + 7)
+        reqs = list(request_stream(
+            pool, len(arr), seed=args.seed + 11 + i,
+            # uint32 id space: probes live in [3e9, 3.5e9)
+            id_offset=3 * 10**9 + i * 10**6,
+        ))
+        return run_open_loop(service, reqs, arr, clock=clock,
+                             tick_interval_s=tick_s)
+
+    result = max_sustained_rate(
+        probe, lo_rps=args.lo_rps, p99_slo_s=slo_s,
+        max_drop_fraction=args.max_drop_fraction,
+        max_doublings=args.search_doublings, iters=args.search_iters,
+    )
+
+    burst_block = None
+    if result.sustained_rps > 0:
+        rate = 0.8 * result.sustained_rps
+        duration = args.open_loop_requests / rate
+        model = TrafficModel(
+            base_rate=rate,
+            diurnal_amplitude=0.3, diurnal_period_s=duration,
+            mmpp_burst_factor=2.0,
+            mmpp_dwell_slow_s=duration / 4, mmpp_dwell_fast_s=duration / 8,
+            flashes=((0.5 * duration, 0.1 * duration, 3.0),),
+        )
+        arr = arrival_times(model, duration, seed=args.seed + 8)
+        reqs = list(request_stream(pool, len(arr), seed=args.seed + 9,
+                                   id_offset=3_500_000_000))
+        rep = run_open_loop(service, reqs, arr, clock=clock,
+                            tick_interval_s=tick_s)
+        burst_block = {
+            "offered_rps_base": round(rate, 3),
+            "traffic_model": {
+                "diurnal_amplitude": 0.3, "mmpp_burst_factor": 2.0,
+                "flash": "3x for 10% of the window at midpoint",
+            },
+            "report": rep.to_json(),
+            "met_slo": rep.meets(slo_s, args.max_drop_fraction),
+        }
+
+    return {
+        "sustained_rps": round(result.sustained_rps, 3),
+        "p99_slo_s": slo_s,
+        "max_drop_fraction": args.max_drop_fraction,
+        "collapse_rps": (round(result.collapse_rps, 3)
+                         if result.collapse_rps is not None else None),
+        "tick_interval_s": tick_s,
+        "requests_per_probe": args.open_loop_requests,
+        "clock": "virtual — capacity is structural (slots x buckets per "
+                 "tick interval), independent of the measuring host",
+        "search": result.to_json(),
+        "at_80pct_with_bursts": burst_block,
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=1000)
@@ -92,6 +195,18 @@ def main() -> int:
                     help="sharded leg: explicit device-id list, e.g. 0,2,5 "
                          "(overrides --mesh)")
     ap.add_argument("--out", type=str, default=OUT)
+    # open-loop headline knobs
+    ap.add_argument("--open-loop-requests", type=int, default=400,
+                    help="offered arrivals per bisection probe")
+    ap.add_argument("--p99-slo-ms", type=float, default=250.0,
+                    help="p99 time-in-system SLO the sustained rate must meet")
+    ap.add_argument("--max-drop-fraction", type=float, default=0.01)
+    ap.add_argument("--tick-interval-ms", type=float, default=50.0,
+                    help="virtual-time service tick interval")
+    ap.add_argument("--lo-rps", type=float, default=20.0,
+                    help="bisection starting guess")
+    ap.add_argument("--search-doublings", type=int, default=6)
+    ap.add_argument("--search-iters", type=int, default=6)
     args = ap.parse_args()
 
     want_sharded = args.mesh > 1 or bool(args.devices.strip())
@@ -195,10 +310,10 @@ def main() -> int:
             "linear_scaling": {"on_chip": None},
         }
 
+    open_loop = run_open_loop_record(pool, args, build_service, Config)
+
     dpr = legs["gnn"]["dispatches_per_request"]
-    record = {
-        "metric": "offload_decision_serving",
-        "platform": args.platform,
+    legacy = {
         "config": {
             "requests_per_leg": args.requests,
             "slots": args.slots,
@@ -226,8 +341,22 @@ def main() -> int:
                  "queueing included in latency",
     }
     if sharded_block is not None:
-        record["sharded"] = sharded_block
-    assert record["dispatch_comparison"]["below_evaluator"], (
+        legacy["sharded"] = sharded_block
+    record = {
+        "metric": "offload_decision_serving",
+        "platform": args.platform,
+        "headline": (
+            f"sustains {open_loop['sustained_rps']} req/s open-loop at "
+            f"p99 time-in-system <= {open_loop['p99_slo_s']}s "
+            f"(drop fraction <= {open_loop['max_drop_fraction']})"
+        ),
+        "open_loop": open_loop,
+        # the original closed-loop record, kept verbatim for continuity
+        # (closed loop self-throttles: its req/s is a lower bound that
+        # hides queueing collapse — hence the open-loop headline above)
+        "legacy": legacy,
+    }
+    assert legacy["dispatch_comparison"]["below_evaluator"], (
         f"serving dispatches/request {dpr} not below the Evaluator's "
         f"{EVALUATOR_DISPATCHES_PER_REQUEST}"
     )

@@ -1,0 +1,29 @@
+"""Seeded violations: JX010 (process-group bring-up outside multihost/).
+
+Both halves of the rule — a raw `jax.distributed.initialize` call and
+ad-hoc process-index/count branching — in a non-multihost directory,
+plus one waived line proving the `# mesh-ok(<why>)` escape hatch
+suppresses a finding without silencing the rest.
+"""
+
+import jax
+
+
+def bring_up(coordinator: str, n: int, pid: int):
+    # JX010: initialize is once-per-process; multihost.runtime owns the
+    # guard, retries and env fallback
+    jax.distributed.initialize(
+        coordinator_address=coordinator, num_processes=n, process_id=pid
+    )
+
+
+def who_am_i() -> bool:
+    return jax.process_index() == 0  # JX010: ad-hoc host-0 fork
+
+
+def fleet_size() -> int:
+    return jax.process_count()  # JX010: topology read outside the runtime
+
+
+def waived_gate() -> bool:
+    return jax.process_index() == 0  # mesh-ok(fixture: reviewed host0-only write gate)

@@ -21,8 +21,8 @@ from multihop_offload_tpu.analysis.cli import main as lint_main
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SEEDED = os.path.join(REPO, "tests", "fixtures", "analysis_seeded")
 ALL_REPO_RULES = {"JX001", "JX002", "JX003", "JX004", "JX005", "JX006",
-                  "JX007", "JX008", "JX009", "MP001", "SL001", "OB001",
-                  "OB002", "OB003"}
+                  "JX007", "JX008", "JX009", "JX010", "MP001", "SL001",
+                  "OB001", "OB002", "OB003"}
 
 
 def run_on(tmp_path, files, select=None, baseline=None):
@@ -588,6 +588,49 @@ def test_jx009_scoped_to_rl(tmp_path):
     assert "JX009" not in rules_hit(rep)
     rep = run_on(tmp_path, {"rl/m.py": src})
     assert "JX009" in rules_hit(rep)
+
+
+def test_jx010_tp_waived_and_fp_guard(tmp_path):
+    rep = run_on(tmp_path, {"parallel/m.py": """\
+        import jax
+        import jax.distributed as jd
+
+        def tp_initialize(coord, n, pid):
+            jax.distributed.initialize(coord, n, pid)
+
+        def tp_alias(coord, n, pid):
+            jd.initialize(coord, n, pid)
+
+        def tp_index():
+            return jax.process_index() == 0
+
+        def tp_count():
+            return jax.process_count()
+
+        def waived():
+            return jax.process_index() == 0  # mesh-ok(host0 write gate)
+
+        def clean(d):
+            # attribute READ on a device object, not a topology call
+            return d.process_index
+    """})
+    jx = [f for f in rep.findings if f.rule == "JX010"]
+    assert [f.line for f in jx] == [5, 8, 11, 14]
+    assert len([f for f in rep.waived if f.rule == "JX010"]) == 1
+
+
+def test_jx010_exempts_multihost(tmp_path):
+    src = """\
+        import jax
+
+        def bootstrap(coord, n, pid):
+            jax.distributed.initialize(coord, n, pid)
+            return jax.process_index()
+    """
+    rep = run_on(tmp_path, {"multihost/runtime.py": src})
+    assert "JX010" not in rules_hit(rep)
+    rep = run_on(tmp_path, {"serve/m.py": src})
+    assert "JX010" in rules_hit(rep)
 
 
 # ---------------------------------------------------------------------------

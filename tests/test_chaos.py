@@ -327,6 +327,22 @@ def test_device_loss_drill_replaces_and_recovers(smoke):
     assert checks["fleet_restored"] and checks["served_after_restore"], rec
 
 
+def test_host_loss_drill_replans_and_conserves(smoke):
+    """The kill-a-whole-host drill: a two-level plan spanning both
+    simulated hosts, victim host removed -> forced replan onto the
+    survivor only, bit-parity (or honest degradation) across the loss,
+    conservation, zero unexpected retraces, host restored."""
+    rec = smoke.run_host_loss()
+    assert "skipped" not in rec, rec  # conftest provides 8 devices
+    checks = rec["checks"]
+    assert checks["plan_spans_hosts_before_loss"], rec
+    assert checks["forced_replan_excludes_victim"], rec
+    assert checks["decisions_never_wrong"], "golden decisions moved"
+    assert checks["conservation"], "requests lost or duplicated"
+    assert checks["zero_unexpected_retraces"], rec
+    assert checks["host_restored"], rec
+
+
 def test_weight_poison_hot_reload_drill(smoke):
     """Checksum-valid NaN poison at the hot-reload surface: both polls
     refused (second proves the cached rejection), champion keeps serving,

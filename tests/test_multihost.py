@@ -1,0 +1,406 @@
+"""multihost/ + loadgen/ unit tests — no `jax.distributed` needed.
+
+The two-level planner, the open-loop driver and the federation merge are
+pure host-side Python by design, so everything here runs in one process:
+plans come from worked host-table examples, open-loop runs drive a fake
+service on the virtual clock, and federation scrapes callable targets
+instead of HTTP endpoints.  The real multi-process loop (2 CPU processes
+under `jax.distributed`) is `mho-mesh --smoke` / scripts/smoke.sh step 13.
+"""
+
+from __future__ import annotations
+
+import math
+from types import SimpleNamespace
+
+import pytest
+
+from multihop_offload_tpu.loadgen import (
+    TrafficModel,
+    VirtualClock,
+    arrival_times,
+    max_sustained_rate,
+    poisson,
+    run_open_loop,
+)
+from multihop_offload_tpu.loadgen.driver import OpenLoopReport
+from multihop_offload_tpu.multihost import (
+    FleetFederation,
+    TwoLevelPlan,
+    TwoLevelPlanner,
+    federated_slo_engine,
+    local_placement,
+    parse_prometheus_text,
+    plan_two_level,
+    validate_plan,
+)
+from multihop_offload_tpu.obs.registry import MetricRegistry
+
+HOSTS = {"hostA": [0, 1, 2, 3], "hostB": [10, 11]}
+
+
+# ---------------------------------------------------------------------------
+# two-level placement
+# ---------------------------------------------------------------------------
+
+
+def test_plan_two_level_worked_example():
+    """rates [4, 2, 1] over a 4-chip host and a 2-chip host, slots=4.
+
+    Greedy in descending-rate order, minimizing resulting per-chip load:
+      bucket0 (4): hostA 4/4=1.0 beats hostB 4/2=2.0      -> hostA
+      bucket1 (2): hostB 2/2=1.0 beats hostA (4+2)/4=1.5  -> hostB
+      bucket2 (1): hostA (4+1)/4=1.25 beats hostB 3/2=1.5 -> hostA
+    """
+    plan = plan_two_level([4.0, 2.0, 1.0], HOSTS, slots=4)
+    assert plan.hosts == ("hostA", "hostB", "hostA")
+    assert plan.buckets_on_host("hostA") == [0, 2]
+    assert plan.buckets_on_host("hostB") == [1]
+    # DCN invariant: every bucket's chips live on its own host
+    for b in range(3):
+        h = plan.host_of(b)
+        assert set(plan.devices_for(b)) <= set(HOSTS[h])
+        assert plan.devices_for(b)  # never empty
+    d = plan.describe()
+    assert d["1"]["host"] == "hostB"
+    assert set(d["1"]["devices"]) <= {10, 11}
+
+
+def test_plan_two_level_deterministic_and_tie_breaks_lex():
+    a = plan_two_level([3.0, 3.0], HOSTS, slots=4)
+    b = plan_two_level([3.0, 3.0], HOSTS, slots=4)
+    assert a == b
+    # equal rates, equal per-chip hosts: ties go to the lower bucket index
+    # first and the lexicographically first host id
+    even = plan_two_level([2.0, 2.0], {"a": [0, 1], "b": [2, 3]}, slots=2)
+    assert even.hosts == ("a", "b")
+
+
+def test_plan_two_level_rejects_bad_tables():
+    with pytest.raises(ValueError, match="at least one host"):
+        plan_two_level([1.0], {}, slots=4)
+    with pytest.raises(ValueError, match="no devices"):
+        plan_two_level([1.0], {"a": []}, slots=4)
+
+
+def test_validate_plan_catches_dcn_spanning():
+    bad = TwoLevelPlan(hosts=("hostA",), devices=((0, 10),))  # 10 is hostB's
+    with pytest.raises(ValueError, match="spans the DCN boundary"):
+        validate_plan(bad, HOSTS)
+    with pytest.raises(ValueError, match="unknown host"):
+        validate_plan(TwoLevelPlan(("ghost",), ((0,),)), HOSTS)
+    with pytest.raises(ValueError, match="no devices"):
+        validate_plan(TwoLevelPlan(("hostA",), ((),)), HOSTS)
+
+
+def test_local_placement_projects_and_placeholders():
+    plan = plan_two_level([4.0, 2.0, 1.0], HOSTS, slots=4)
+    # hostB's process: bucket 1 translated onto its local device objects,
+    # the foreign buckets get a 1-device placeholder
+    local = ["devX", "devY"]
+    pp = local_placement(plan, "hostB", local)
+    assert len(pp.assignments) == 3
+    assert set(pp.assignments[1]) <= set(local)
+    assert len(pp.assignments[1]) == len(plan.devices_for(1))
+    assert pp.assignments[0] == ("devX",)   # placeholder: fallback device
+    assert pp.assignments[2] == ("devX",)
+    # explicit fallback override
+    pp2 = local_placement(plan, "hostB", local, fallback_device="devY")
+    assert pp2.assignments[0] == ("devY",)
+    # a plan wanting more chips than this process has is a loud error
+    with pytest.raises(ValueError, match="has 1 locally"):
+        local_placement(plan, "hostA", ["only_one"])
+    with pytest.raises(ValueError, match="at least one local device"):
+        local_placement(plan, "hostB", [])
+
+
+def test_planner_hysteresis_does_not_thrash_on_jitter():
+    planner = TwoLevelPlanner(2, HOSTS, slots=4, alpha=0.5, hysteresis=0.2)
+    planner.observe([8.0, 4.0])
+    first = planner.replan()
+    base = planner.replans
+    # +-10% jitter around the same rates: the candidate never beats the
+    # current plan by the 20% hysteresis margin -> zero switches
+    for jitter in (1.1, 0.9, 1.05, 0.95, 1.0):
+        planner.observe([8.0 * jitter, 4.0 * jitter])
+        assert planner.replan() is first
+    assert planner.replans == base
+
+
+def test_planner_host_removal_forces_replan_and_recovery_waits():
+    planner = TwoLevelPlanner(2, HOSTS, slots=4)
+    planner.observe([3.0, 2.0])
+    plan = planner.replan()
+    assert set(plan.hosts) == {"hostA", "hostB"}  # spans both
+    before = planner.replans
+    plan2 = planner.remove_host("hostB")
+    assert planner.replans == before + 1
+    assert set(plan2.hosts) == {"hostA"}
+    validate_plan(plan2, planner.hosts)
+    # recovery: capacity restored, but hysteresis decides adoption — the
+    # returned plan must still be valid against the grown table
+    plan3 = planner.add_host("hostB", HOSTS["hostB"])
+    validate_plan(plan3, planner.hosts)
+    assert "hostB" in planner.hosts
+
+
+def test_planner_rejects_mismatch_and_empty_fleet():
+    planner = TwoLevelPlanner(2, HOSTS, slots=4)
+    with pytest.raises(ValueError, match="arrival counts"):
+        planner.observe([1.0])
+    planner.remove_host("hostB")
+    with pytest.raises(ValueError, match="empty after host removal"):
+        planner.remove_host("hostA")
+
+
+# ---------------------------------------------------------------------------
+# loadgen: arrivals
+# ---------------------------------------------------------------------------
+
+
+def test_arrivals_deterministic_and_sorted():
+    m = TrafficModel(base_rate=50.0, diurnal_amplitude=0.3,
+                     diurnal_period_s=10.0, mmpp_burst_factor=2.0,
+                     mmpp_dwell_slow_s=2.0, mmpp_dwell_fast_s=1.0,
+                     flashes=((4.0, 1.0, 3.0),))
+    a = arrival_times(m, 10.0, seed=7)
+    b = arrival_times(m, 10.0, seed=7)
+    assert a == b
+    assert a == sorted(a)
+    assert all(0.0 <= t < 10.0 for t in a)
+    assert arrival_times(m, 10.0, seed=8) != a
+
+
+def test_arrivals_mean_rate_tracks_base_rate():
+    # plain Poisson: count over a long window concentrates near rate*T
+    n = len(arrival_times(poisson(200.0), 50.0, seed=3))
+    assert abs(n - 200.0 * 50.0) < 5 * math.sqrt(200.0 * 50.0)
+
+
+def test_traffic_model_validation_and_envelope():
+    with pytest.raises(ValueError):
+        TrafficModel(base_rate=0.0)
+    with pytest.raises(ValueError):
+        TrafficModel(base_rate=1.0, diurnal_amplitude=1.0)
+    with pytest.raises(ValueError):
+        TrafficModel(base_rate=1.0, mmpp_burst_factor=0.5)
+    m = TrafficModel(base_rate=10.0, diurnal_amplitude=0.5,
+                     mmpp_burst_factor=2.0, flashes=((0.0, 1.0, 3.0),))
+    assert m.envelope_rate() == pytest.approx(10.0 * 1.5 * 2.0 * 3.0)
+    assert m.at(20.0).base_rate == 20.0
+    # flash window half-open: active at start, off at start+dur
+    assert m.flash_factor(0.0) == 3.0 and m.flash_factor(1.0) == 1.0
+
+
+# ---------------------------------------------------------------------------
+# loadgen: open-loop driver + bisection (fake service, pure python)
+# ---------------------------------------------------------------------------
+
+
+class FakeService:
+    """Bounded queue, fixed drain per tick — a deterministic M/D/1-ish
+    stand-in exposing the submit/tick subset the driver uses."""
+
+    def __init__(self, queue_cap: int = 8, per_tick: int = 4):
+        self.queue_cap = queue_cap
+        self.per_tick = per_tick
+        self.q = []
+        self.last_submit_outcome = None
+
+    def submit(self, req, now=None):
+        if len(self.q) >= self.queue_cap:
+            self.last_submit_outcome = "backpressure"
+            return False
+        self.q.append(float(now))
+        self.last_submit_outcome = "admitted"
+        return True
+
+    def tick(self, now=None):
+        batch, self.q = self.q[: self.per_tick], self.q[self.per_tick:]
+        return [SimpleNamespace(latency_s=float(now) - t, served_by="gnn")
+                for t in batch]
+
+
+def test_open_loop_underload_serves_everything():
+    clock = VirtualClock()
+    svc = FakeService(queue_cap=8, per_tick=4)  # 4/0.1s = 40 req/s capacity
+    arr = arrival_times(poisson(10.0), 5.0, seed=1)
+    rep = run_open_loop(svc, [object()] * len(arr), arr,
+                        clock=clock, tick_interval_s=0.1)
+    assert rep.offered == len(arr)
+    assert rep.dropped == 0 and rep.drop_fraction == 0.0
+    assert rep.served == rep.admitted == rep.offered  # conservation
+    assert rep.drained
+    assert rep.outcomes == {"admitted": rep.offered}
+    assert rep.p99_s is not None and rep.p99_s <= 0.3
+    assert rep.meets(p99_slo_s=0.5, max_drop_fraction=0.0)
+
+
+def test_open_loop_overload_shows_drops_not_backoff():
+    clock = VirtualClock()
+    svc = FakeService(queue_cap=8, per_tick=4)  # 40 req/s capacity
+    arr = arrival_times(poisson(200.0), 3.0, seed=2)
+    rep = run_open_loop(svc, [object()] * len(arr), arr,
+                        clock=clock, tick_interval_s=0.1)
+    # open loop keeps offering at 200/s: ~80% must drop, visibly
+    assert rep.drop_fraction > 0.5
+    assert rep.outcomes.get("backpressure", 0) == rep.dropped
+    assert rep.served == rep.admitted and rep.drained  # admitted all answer
+    assert not rep.meets(p99_slo_s=10.0, max_drop_fraction=0.01)
+
+
+def test_open_loop_rejects_bad_tick_and_clock_never_rewinds():
+    with pytest.raises(ValueError, match="tick_interval_s"):
+        run_open_loop(FakeService(), [], [], clock=VirtualClock(),
+                      tick_interval_s=0.0)
+    c = VirtualClock(5.0)
+    with pytest.raises(ValueError, match="rewind"):
+        c.seek(4.0)
+    c.advance(1.0)
+    assert c() == 6.0
+
+
+def _fake_report(ok: bool) -> OpenLoopReport:
+    return OpenLoopReport(
+        offered=100, admitted=100 if ok else 60,
+        dropped=0 if ok else 40, served=100 if ok else 60, degraded=0,
+        duration_s=1.0, offered_rate=100.0, served_rate=100.0,
+        drop_fraction=0.0 if ok else 0.4,
+        p50_s=0.01, p95_s=0.02, p99_s=0.05 if ok else 9.0, max_s=0.1,
+        drained=True, outcomes={},
+    )
+
+
+def test_bisection_pins_the_knee():
+    knee = 37.0
+    res = max_sustained_rate(
+        lambda r: _fake_report(r <= knee),
+        lo_rps=10.0, p99_slo_s=1.0, iters=8, max_doublings=4,
+    )
+    assert res.sustained_rps <= knee < res.collapse_rps
+    assert res.collapse_rps - res.sustained_rps < 1.0  # 8 bisection steps
+    assert all("offered_rps" in p for p in res.probes)  # whole search path
+    assert any(p["ok"] for p in res.probes)
+    assert any(not p["ok"] for p in res.probes)
+
+
+def test_bisection_walks_down_when_lo_fails_and_reports_zero_floor():
+    res = max_sustained_rate(
+        lambda r: _fake_report(r <= 5.0),
+        lo_rps=40.0, p99_slo_s=1.0, iters=6, max_doublings=4,
+    )
+    assert 0 < res.sustained_rps <= 5.0
+    # a service that sustains nothing reports 0, not an exception
+    res0 = max_sustained_rate(
+        lambda r: _fake_report(False),
+        lo_rps=8.0, p99_slo_s=1.0, iters=4, max_doublings=3,
+    )
+    assert res0.sustained_rps == 0.0
+    with pytest.raises(ValueError):
+        max_sustained_rate(lambda r: _fake_report(True), lo_rps=0.0,
+                           p99_slo_s=1.0)
+
+
+def test_bisection_never_failing_returns_proven_rate():
+    res = max_sustained_rate(
+        lambda r: _fake_report(True),
+        lo_rps=10.0, p99_slo_s=1.0, iters=4, max_doublings=3,
+    )
+    assert res.sustained_rps == 80.0  # 10 * 2^3, the last PROVEN rate
+    assert res.collapse_rps is None
+
+
+# ---------------------------------------------------------------------------
+# federation (callable targets — no sockets)
+# ---------------------------------------------------------------------------
+
+
+def _host_registry(served: int, lat: float) -> MetricRegistry:
+    reg = MetricRegistry()
+    reg.counter("mho_serve_served_total", "t").inc(served, served_by="gnn")
+    reg.histogram("mho_serve_latency_seconds", "t",
+                  buckets=[0.1, 1.0]).observe(lat)
+    reg.gauge("mho_serve_queue_depth", "t").set(3.0)
+    return reg
+
+
+def test_prometheus_parse_round_trip():
+    reg = _host_registry(served=7, lat=0.05)
+    fams = parse_prometheus_text(reg.prometheus_text())
+    c = fams["mho_serve_served_total"]
+    assert c["kind"] == "counter"
+    assert c["series"][(("served_by", "gnn"),)] == 7.0
+    h = fams["mho_serve_latency_seconds"]
+    assert h["kind"] == "histogram"
+    assert h["boundaries"] == [0.1, 1.0]
+    (key, s), = h["series"].items()
+    assert s["count"] == 1 and s["buckets"] == [1, 0, 0]  # de-cumulated
+    assert s["sum"] == pytest.approx(0.05)
+    assert fams["mho_serve_queue_depth"]["series"][()] == 3.0
+
+
+def test_federation_merges_hosts_and_deltas():
+    regs = {"host0": _host_registry(7, 0.05), "host1": _host_registry(5, 2.0)}
+    fed = FleetFederation(
+        {h: r.prometheus_text for h, r in regs.items()})
+    assert fed.scrape() == {"host0": True, "host1": True}
+    served = fed.registry.counter("mho_serve_served_total")
+    assert served.total() == 12.0                      # fleet-wide
+    assert served.total(host="host0") == 7.0           # per-host breakdown
+    assert served.total(host="host1") == 5.0
+    # second scrape with only host0 moving: DELTA applied, not re-added
+    regs["host0"].counter("mho_serve_served_total").inc(3, served_by="gnn")
+    fed.scrape()
+    assert served.total() == 15.0
+    assert served.total(host="host1") == 5.0
+    # histograms federate too: host1's 2.0s obs lands above the 1.0 edge
+    hist = fed.registry.histogram("mho_serve_latency_seconds",
+                                  buckets=[0.1, 1.0])
+    good, total = hist.le_total(1.0)
+    assert (good, total) == (1, 2)
+
+
+def test_federation_counter_reset_treated_as_fresh():
+    reg = _host_registry(10, 0.05)
+    fed = FleetFederation({"host0": reg.prometheus_text})
+    fed.scrape()
+    served = fed.registry.counter("mho_serve_served_total")
+    assert served.total() == 10.0
+    # source restarted: its cumulative count went DOWN — the whole new
+    # value is the delta (never negative, never double-subtracted)
+    fresh = _host_registry(2, 0.05)
+    fed.targets["host0"] = fresh.prometheus_text
+    fed.scrape()
+    assert served.total() == 12.0
+
+
+def test_federation_dead_host_is_data():
+    live = _host_registry(7, 0.05)
+
+    def dead():
+        raise OSError("connection refused")
+
+    fed = FleetFederation({"host0": live.prometheus_text, "host1": dead})
+    ok = fed.scrape()
+    assert ok == {"host0": True, "host1": False}
+    up = fed.registry.gauge("mho_mesh_host_up")
+    assert up.value(host="host0") == 1.0
+    assert up.value(host="host1") == 0.0
+    fails = fed.registry.counter("mho_mesh_scrape_failures_total")
+    assert fails.total(host="host1") == 1.0
+    # the live host's series merged regardless
+    assert fed.registry.counter("mho_serve_served_total").total() == 7.0
+
+
+def test_federated_slo_engine_sees_fleet_series():
+    regs = {"host0": _host_registry(7, 0.05), "host1": _host_registry(5, 0.2)}
+    for r in regs.values():  # delivered-ratio denominators
+        r.counter("mho_serve_submits_total", "t").inc(7, outcome="admitted")
+    fed = FleetFederation({h: r.prometheus_text for h, r in regs.items()})
+    fed.scrape()
+    engine = federated_slo_engine(fed, short_s=1.0, long_s=2.0)
+    assert engine.registry is fed.registry
+    # two observations so every spec has a window; no alert may fire on
+    # healthy fleet data
+    assert engine.observe(0.0) == []
+    assert engine.observe(1.0) == []

@@ -213,3 +213,40 @@ def test_sharded_chebnet_matches_dense():
     )
     got = np.asarray(f(variables, feats, support))
     np.testing.assert_allclose(got, expect, rtol=1e-12, atol=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# serving-fleet resolution (cli.serve.resolve_serve_devices)
+# ---------------------------------------------------------------------------
+
+
+def test_resolve_serve_devices_mesh_clamps_with_warning():
+    """serve_mesh larger than the fleet clamps to every present device and
+    says so — a degraded-capacity start must be visible, not silent."""
+    from multihop_offload_tpu.cli.serve import resolve_serve_devices
+
+    with pytest.warns(RuntimeWarning, match="clamping"):
+        devs = resolve_serve_devices(Config(serve_mesh=len(jax.devices()) + 5))
+    assert devs == list(jax.devices())
+    # in-range mesh takes the first N, no warning
+    devs = resolve_serve_devices(Config(serve_mesh=2))
+    assert devs == list(jax.devices())[:2]
+    # mesh <= 1 means the single-device executor
+    assert resolve_serve_devices(Config()) is None
+
+
+def test_resolve_serve_devices_explicit_ids_win_and_missing_raise():
+    """An explicit serve_devices id list overrides serve_mesh (order
+    preserved), and ids not present fail loudly with the virtual-device
+    hint instead of clamping."""
+    from multihop_offload_tpu.cli.serve import resolve_serve_devices
+
+    fleet = jax.devices()
+    cfg = Config(serve_devices=f"{fleet[2].id},{fleet[0].id}",
+                 serve_mesh=len(fleet) + 5)   # would clamp; ids must win
+    out = resolve_serve_devices(cfg)
+    assert [d.id for d in out] == [fleet[2].id, fleet[0].id]
+    with pytest.raises(ValueError, match="not present"):
+        resolve_serve_devices(Config(serve_devices="999999"))
+    with pytest.raises(ValueError, match="int ids"):
+        resolve_serve_devices(Config(serve_devices="0,x"))

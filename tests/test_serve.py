@@ -286,11 +286,19 @@ def test_loadgen_soak(tmp_path):
     proc = subprocess.run(
         [sys.executable, os.path.join(repo, "scripts", "serve_loadgen.py"),
          "--requests", "60", "--slots", "4", "--queue-cap", "16",
+         "--open-loop-requests", "60", "--search-doublings", "3",
+         "--search-iters", "3",
          "--out", str(out)],
         capture_output=True, text=True, timeout=900, cwd=repo,
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     rec = json.loads(out.read_text())
-    assert rec["dispatch_comparison"]["below_evaluator"] is True
-    assert rec["legs"]["gnn"]["served"] == 60
-    assert rec["legs"]["degraded"]["degraded"] == 60
+    # closed-loop continuity record, nested under `legacy`
+    assert rec["legacy"]["dispatch_comparison"]["below_evaluator"] is True
+    assert rec["legacy"]["legs"]["gnn"]["served"] == 60
+    assert rec["legacy"]["legs"]["degraded"]["degraded"] == 60
+    # open-loop headline: a finite sustained rate that met the SLO
+    ol = rec["open_loop"]
+    assert ol["sustained_rps"] > 0
+    assert any(p["ok"] for p in ol["search"]["probes"])
+    assert "sustains" in rec["headline"]
