@@ -23,6 +23,12 @@ JX010 mesh bring-up     `jax.distributed.initialize` / process-index
                         branching outside multihost/ — process-group
                         formation has one owner (multihost.runtime), so
                         retry/backoff/idempotence live in one place
+JX011 topology drawing  raw `networkx` graph constructors outside
+                        graphs/ — ad-hoc draws skip the connectivity
+                        retry, the seeded-determinism contract and the
+                        (adj, pos) dtype normalization that
+                        graphs.generators owns (the scenario matrix's
+                        realizations must be reproducible per seed)
 
 JX001 runs a small intraprocedural taint pass over each jit-reachable
 function (see `reachability`): values produced by `jax.*` calls are
@@ -695,5 +701,45 @@ def check_jx010(mod: ModuleCtx) -> Iterator[Finding]:
         yield Finding(
             rule="JX010", path=mod.path, line=node.lineno,
             message=(msg + ", or waive with '# mesh-ok(<why>)'"),
+            snippet=_snippet(mod, node),
+        )
+
+
+# ---------------------------------------------------------------------------
+# JX011 — raw networkx topology draws outside graphs/
+# ---------------------------------------------------------------------------
+
+# the classic constructor surface: nx.<family>_graph(...) plus the bare
+# container classes people reach for when hand-building a topology
+_JX011_CLASSES = {"networkx.Graph", "networkx.DiGraph", "networkx.MultiGraph"}
+
+
+@rule(
+    id="JX011", severity="error",
+    scope="package (graphs/ exempt — it owns topology drawing)",
+    waiver="# topo-ok(",
+    doc=("raw networkx graph constructor outside graphs/ — topology draws "
+         "go through graphs.generators.generate so every caller gets the "
+         "bounded connectivity retry, per-seed determinism and the "
+         "(adj, pos) contract; an ad-hoc nx draw silently reintroduces the "
+         "disconnected-graph hazard the generators close"),
+    exempt_dirs=("graphs",),
+)
+def check_jx011(mod: ModuleCtx) -> Iterator[Finding]:
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        canon = mod.canonical(node.func) if isinstance(
+            node.func, (ast.Name, ast.Attribute)) else None
+        if canon is None or not canon.startswith("networkx."):
+            continue
+        if not (canon.endswith("_graph") or canon in _JX011_CLASSES):
+            continue
+        yield Finding(
+            rule="JX011", path=mod.path, line=node.lineno,
+            message=(f"{canon}() outside graphs/ — draw topologies through "
+                     "graphs.generators.generate (connectivity retry, "
+                     "seeded determinism, (adj, pos) contract), or waive "
+                     "with '# topo-ok(<why>)'"),
             snippet=_snippet(mod, node),
         )

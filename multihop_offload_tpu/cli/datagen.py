@@ -80,7 +80,12 @@ def generate_dataset(
             if gtype == "poisson":
                 adj, pos, m_eff = generators.connected_poisson_disk(num_nodes, seed=seed)
             else:
-                adj, _ = generators.generate(gtype, num_nodes, seed=seed, m=m)
+                # `m` is the BA attachment degree; other families have their
+                # own parameters and `generate` raises if handed a stray `m`
+                adj, _ = generators.generate(
+                    gtype, num_nodes, seed=seed,
+                    **({"m": m} if gtype == "ba" else {}),
+                )
                 pos = generators.spring_positions(adj, seed=seed)
                 m_eff = m
             graph = nx.from_numpy_array(adj)

@@ -1,0 +1,105 @@
+"""Scenario-matrix entry point (`mho-scenarios`).
+
+    mho-scenarios                  # list the preset registry (name, family,
+                                   # axes) — the spec table OPERATIONS.md
+                                   # documents
+    mho-scenarios --matrix         # every preset through the analytic
+                                   # evaluator AND FleetSim in one process;
+                                   # writes benchmarks/scenario_matrix.json
+    mho-scenarios --matrix --smoke # CPU drill (<90 s): subset of presets,
+                                   # asserts conservation + both paths +
+                                   # zero unexpected retraces (smoke.sh
+                                   # step 14)
+
+Shapes come from the `scenario_*` config knobs; `--scenario_names=a,b`
+restricts a full matrix run to named presets.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+from multihop_offload_tpu.config import Config, build_parser
+
+_OUT_DEFAULT = "benchmarks/scenario_matrix.json"
+_OUT_SMOKE = "benchmarks/scenario_smoke.json"
+
+
+def list_presets() -> dict:
+    """The registry as a JSON-ready table (the default CLI surface)."""
+    from multihop_offload_tpu.scenarios import presets as presets_mod
+    from multihop_offload_tpu.scenarios.matrix import _traffic_axes
+    from multihop_offload_tpu.scenarios.spec import spec_hash
+
+    rows = []
+    for name in presets_mod.preset_names():
+        s = presets_mod.preset(name)
+        rows.append({
+            "name": name,
+            "hash": spec_hash(s),
+            "family": s.family,
+            "n_nodes": s.n_nodes,
+            "util": s.util,
+            "traffic": _traffic_axes(s.traffic),
+            "mu_spread": s.mu_spread,
+            "failures": len(s.failures),
+            "mobility": s.mobility is not None,
+            "objective": not s.objective.is_null,
+        })
+    return {"presets": rows,
+            "new_families": list(presets_mod.NEW_FAMILIES)}
+
+
+def main(argv=None):
+    from multihop_offload_tpu import obs
+    from multihop_offload_tpu.utils.platform import apply_platform_env
+
+    p = build_parser()
+    p.add_argument("--matrix", action="store_true",
+                   help="run every preset through both evaluators and "
+                        "write the scenario_matrix.json record")
+    p.add_argument("--smoke", action="store_true",
+                   help="with --matrix: CPU drill on the smoke subset, "
+                        "asserting conservation, both evaluation paths, "
+                        "new-family coverage, drift detection, and zero "
+                        "unexpected retraces")
+    p.add_argument("--list", action="store_true",
+                   help="print the preset registry (the default)")
+    ns = p.parse_args(argv)
+    cfg = Config(**{f.name: getattr(ns, f.name)
+                    for f in dataclasses.fields(Config)})
+    apply_platform_env()
+
+    if not ns.matrix:
+        print(json.dumps(list_presets(), indent=2))
+        return 0
+
+    from multihop_offload_tpu.cli.loop import write_record
+    from multihop_offload_tpu.scenarios.matrix import run_matrix
+
+    runlog = obs.start_run(cfg, role="scenarios")
+    try:
+        record = run_matrix(cfg, ns.smoke or False)
+    finally:
+        obs.finish_run(runlog)
+    out_path = cfg.scenario_out or (_OUT_SMOKE if ns.smoke else _OUT_DEFAULT)
+    write_record(record, out_path)
+    print(f"scenario matrix record written to {out_path}")
+    summary = {
+        "scenarios": len(record["scenarios"]),
+        "families": record["families"],
+        "conservation_ok_all": record["conservation_ok_all"],
+        "unexpected_retraces": record["unexpected_retraces"],
+    }
+    if ns.smoke:
+        summary["checks"] = record["checks"]
+        summary["ok"] = record["ok"]
+    print(json.dumps(summary, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

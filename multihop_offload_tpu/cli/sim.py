@@ -23,15 +23,15 @@ import numpy as np
 from multihop_offload_tpu.config import Config, build_parser
 
 
-def _make_gnn_policy(cfg: Config, pad):
-    """Build the GNN policy function; checkpoint if present, else fresh init
-    (mirrors `cli.serve` — an untrained GNN still exercises the loop)."""
+def load_gnn(cfg: Config, pad):
+    """(model, variables): checkpoint if present, else fresh init (mirrors
+    `cli.serve` — an untrained GNN still exercises the loop).  Shared by the
+    sim policy here and the scenario matrix's analytic GNN evaluation."""
     import jax
     import jax.numpy as jnp
 
     from multihop_offload_tpu.layouts import zeros_support
     from multihop_offload_tpu.models import make_model
-    from multihop_offload_tpu.sim.policies import make_policy
     from multihop_offload_tpu.train import checkpoints as ckpt_lib
 
     model = make_model(cfg)
@@ -59,6 +59,14 @@ def _make_gnn_policy(cfg: Config, pad):
     print("sim gnn policy: "
           + (f"checkpoint step {loaded}" if loaded is not None
              else "fresh-init weights"))
+    return model, variables
+
+
+def _make_gnn_policy(cfg: Config, pad):
+    """Build the GNN sim policy function from `load_gnn`'s weights."""
+    from multihop_offload_tpu.sim.policies import make_policy
+
+    model, variables = load_gnn(cfg, pad)
     return make_policy("gnn", model=model, variables=variables,
                        precision=cfg.precision_policy,
                        layout=cfg.layout_policy)

@@ -230,6 +230,19 @@ class Config:
     sim_fail_nodes: int = 0        # random non-server nodes to fail likewise
     sim_out: str = ""              # write the run/fidelity JSON record here
     #                                ("" = print only / default record path)
+    # ---- scenario matrix (scenarios/ subsystem; cli.scenarios) -------------
+    scenario_fleet: int = 4        # lanes (seeded draws) per scenario preset
+    scenario_segments: int = 4     # sim segments per scenario — the traffic
+    #                                model modulates arrivals PER SEGMENT and
+    #                                mobility re-wires at segment boundaries
+    scenario_rounds: int = 2       # policy re-decisions per segment
+    scenario_slots: int = 300      # slots per policy round
+    scenario_cap: int = 64         # per-queue ring-buffer capacity
+    scenario_margin: float = 5.0   # slot sizing, as sim_margin
+    scenario_names: str = ""       # comma list restricting the matrix to
+    #                                these presets ("" = all presets)
+    scenario_out: str = ""         # matrix record path ("" = the default
+    #                                benchmarks/scenario_matrix.json)
     # ---- on-device RL (rl/ subsystem; cli.rl) ------------------------------
     rl_steps: int = 30             # compiled train steps per `mho-rl train`
     rl_fleet: int = 4              # episodes (instances) per train step —

@@ -11,12 +11,42 @@ server list is ascending.
 
 from __future__ import annotations
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 from flax import struct
 
 from multihop_offload_tpu.graphs.instance import Instance, JobSet
 from multihop_offload_tpu.precision import island_dtype
+
+
+@dataclasses.dataclass(frozen=True)
+class ObjectiveWeights:
+    """Energy/cost weights folded into the offloading cost table.
+
+    Plain Python floats resolved at policy BUILD time and closed over
+    (compile-once discipline: changing a weight is a new program, wrapped
+    in `jaxhooks.expected_rebuild()` by the scenario runner).  The weights
+    bias only the DECISION — delay scoring downstream stays physical:
+
+      transport_energy  cost per hop per unit of data shipped (radio energy
+                        proxy): charged to the server options as
+                        ``w * (hop_ul * ul + hop_dl * dl)``
+      compute_energy    remote-compute premium per unit of uplink data
+                        (cloud $/J proxy): charged flat to every server
+
+    Local compute is the zero-cost reference point, so rising weights pull
+    decisions toward local / nearer servers.  The default (all-zero) is
+    bit-identical to the unweighted objective.
+    """
+
+    transport_energy: float = 0.0
+    compute_energy: float = 0.0
+
+    @property
+    def is_null(self) -> bool:
+        return self.transport_energy == 0.0 and self.compute_energy == 0.0
 
 
 @struct.dataclass
@@ -36,6 +66,7 @@ def offload_decide(
     key: jax.Array,
     explore: float | jnp.ndarray = 0.0,
     prob: bool = False,
+    objective: ObjectiveWeights | None = None,
 ) -> OffloadDecision:
     """Choose a compute destination per job.
 
@@ -66,6 +97,17 @@ def offload_decide(
     dl = jnp.maximum(dl, hop[servers[None, :], src[:, None]].astype(dt))
     proc = jnp.maximum(proc, 1.0)
     server_delays = ul + dl + proc                               # (J, S)
+    if objective is not None and not objective.is_null:
+        # energy/cost-weighted objective: penalize the server options by the
+        # shipped-data x hop-distance transport cost and a flat remote-
+        # compute premium; local (the reference point) stays unpenalized
+        hop_ul = hop[src[:, None], servers[None, :]].astype(dt)  # (J, S)
+        hop_dl = hop[servers[None, :], src[:, None]].astype(dt)
+        server_delays = server_delays + (
+            objective.transport_energy
+            * (hop_ul * ul_d[:, None] + hop_dl * dl_d[:, None])
+            + objective.compute_energy * ul_d[:, None]
+        )
 
     inf = jnp.array(jnp.inf, dtype=server_delays.dtype)
     server_delays = jnp.where(smask[None, :], server_delays, inf)

@@ -54,6 +54,7 @@ def evaluate_spmatrix_policy(
     fp_fn=None,
     layout=None,
     apsp_edges_fn=None,
+    objective=None,
 ) -> PolicyOutcome:
     """Offload + route + run given per-link unit delays and a node diagonal.
 
@@ -93,7 +94,8 @@ def evaluate_spmatrix_policy(
             )
         sp = apsp(w)
     # hop counts are topology-only and precomputed at Instance build time
-    dec = offload_decide(inst, jobs, sp, inst.hop, unit_diag, key, explore, prob)
+    dec = offload_decide(inst, jobs, sp, inst.hop, unit_diag, key, explore,
+                         prob, objective=objective)
     if lay.sparse:
         nh = next_hop_from_edges(inst.link_ends, inst.link_mask, sp)
     else:
@@ -105,13 +107,13 @@ def evaluate_spmatrix_policy(
 
 def baseline_policy(
     inst: Instance, jobs: JobSet, key: jax.Array, explore=0.0, prob: bool = False,
-    apsp_fn=None, fp_fn=None, layout=None,
+    apsp_fn=None, fp_fn=None, layout=None, objective=None,
 ) -> PolicyOutcome:
     """Congestion-agnostic greedy offloading (`AdHoc_train.py:128-141`)."""
     link_d, node_d = baseline_unit_delays(inst)
     return evaluate_spmatrix_policy(
         inst, jobs, link_d, node_d, key, explore, prob, apsp_fn=apsp_fn,
-        fp_fn=fp_fn, layout=layout,
+        fp_fn=fp_fn, layout=layout, objective=objective,
     )
 
 

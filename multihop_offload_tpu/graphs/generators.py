@@ -11,15 +11,70 @@ the returned dense adjacency is fixed-shape JAX.
 All generators return ``(adj, pos)`` with ``adj`` a dense ``(n, n)`` uint8
 symmetric 0/1 matrix with zero diagonal and ``pos`` an ``(n, 2)`` float array
 of node coordinates (or ``None`` when the family has no natural geometry).
+
+Beyond the reference families, the scenario matrix (`scenarios/`) adds
+planned deployments the paper never evaluated: `grid` / `corridor`
+lattices (warehouse / road-segment layouts) and `two_tier` clustered
+edge/cloud topologies (dense local clusters bridged through a small cloud
+core).  Everything downstream is family-agnostic — a family is just a name
+in `GENERATORS` returning the same ``(adj, pos)`` contract.
+
+Connectivity: the sim strands packets (and admission refuses with
+``disconnected``) on a disconnected graph, so the random families whose
+draws can disconnect (`erdos_renyi`, `gaussian_random_partition`) retry a
+bounded number of times at increasing density, mirroring
+`connected_poisson_disk`; the typed `DisconnectedGraphWarning` marks every
+draw where the fallback engaged.
 """
 
 from __future__ import annotations
 
+import warnings
 from typing import Optional, Tuple
 
 import networkx as nx
 import numpy as np
 from scipy.spatial import distance_matrix
+
+
+class DisconnectedGraphWarning(UserWarning):
+    """A generator's nominal draw was disconnected and the bounded
+    densify-and-retry fallback engaged (the returned graph IS connected,
+    but denser than the family's nominal parameterization)."""
+
+
+# bounded retry-to-connected: densify by _RETRY_GROWTH per attempt, give up
+# (raise) after _MAX_CONNECT_TRIES total draws
+_MAX_CONNECT_TRIES = 8
+_RETRY_GROWTH = 1.5
+
+
+def _is_connected(adj: np.ndarray) -> bool:
+    return bool(nx.is_connected(nx.from_numpy_array(adj)))
+
+
+def _retry_connected(draw, family: str, n: int):
+    """Run `draw(attempt)` until the graph connects (bounded).
+
+    `draw` maps an attempt index (0 = nominal parameters) to ``(adj, pos)``;
+    the densification schedule lives in the caller's closure.  Mirrors
+    `connected_poisson_disk`'s densify-until-connected loop, but bounded and
+    with the typed warning contract."""
+    for attempt in range(_MAX_CONNECT_TRIES):
+        adj, pos = draw(attempt)
+        if _is_connected(adj):
+            return adj, pos
+        if attempt == 0:
+            warnings.warn(
+                f"{family}(n={n}) drew a disconnected graph; densifying "
+                f"and retrying (bounded, x{_RETRY_GROWTH} per attempt)",
+                DisconnectedGraphWarning,
+                stacklevel=3,
+            )
+    raise ValueError(
+        f"{family}(n={n}) stayed disconnected after "
+        f"{_MAX_CONNECT_TRIES} densifying retries"
+    )
 
 
 def _to_adj(g: nx.Graph, n: int) -> np.ndarray:
@@ -35,10 +90,21 @@ def barabasi_albert(n: int, m: int = 2, seed: int = 0) -> Tuple[np.ndarray, None
     return _to_adj(nx.barabasi_albert_graph(n, m, seed=seed), n), None
 
 
-def gaussian_random_partition(n: int, seed: int = 0) -> Tuple[np.ndarray, None]:
-    """GRP(n, 15, 3, 0.4, 0.2) (reference `offloading_v3.py:41-42`)."""
-    g = nx.gaussian_random_partition_graph(n, 15, 3, 0.4, 0.2, seed=seed)
-    return _to_adj(g, n), None
+def gaussian_random_partition(
+    n: int, p_in: float = 0.4, p_out: float = 0.2, seed: int = 0
+) -> Tuple[np.ndarray, None]:
+    """GRP(n, 15, 3, p_in, p_out) (reference `offloading_v3.py:41-42`),
+    densified-and-retried to connectivity (bounded)."""
+
+    def draw(attempt):
+        grow = _RETRY_GROWTH ** attempt
+        g = nx.gaussian_random_partition_graph(
+            n, 15, 3, min(p_in * grow, 1.0), min(p_out * grow, 1.0),
+            seed=seed + 7919 * attempt,
+        )
+        return _to_adj(g, n), None
+
+    return _retry_connected(draw, "gaussian_random_partition", n)
 
 
 def watts_strogatz(n: int, k: int = 6, p: float = 0.2, seed: int = 0) -> Tuple[np.ndarray, None]:
@@ -47,10 +113,18 @@ def watts_strogatz(n: int, k: int = 6, p: float = 0.2, seed: int = 0) -> Tuple[n
     return _to_adj(g, n), None
 
 
-def erdos_renyi(n: int, seed: int = 0) -> Tuple[np.ndarray, None]:
-    """ER with expected degree 15 (reference `offloading_v3.py:45-46`)."""
-    g = nx.fast_gnp_random_graph(n, 15.0 / float(n), seed=seed)
-    return _to_adj(g, n), None
+def erdos_renyi(
+    n: int, degree: float = 15.0, seed: int = 0
+) -> Tuple[np.ndarray, None]:
+    """ER with expected degree `degree` (reference `offloading_v3.py:45-46`),
+    densified-and-retried to connectivity (bounded)."""
+
+    def draw(attempt):
+        p = min(degree * (_RETRY_GROWTH ** attempt) / float(n), 1.0)
+        g = nx.fast_gnp_random_graph(n, p, seed=seed + 7919 * attempt)
+        return _to_adj(g, n), None
+
+    return _retry_connected(draw, "erdos_renyi", n)
 
 
 def unit_disk_adjacency(pos: np.ndarray, radius: float = 1.0) -> np.ndarray:
@@ -94,21 +168,151 @@ def connected_poisson_disk(
             return adj, pos, nb
 
 
+def _lattice(n: int, rows: int, seed: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+    """Row-major induced lattice over the first `n` cells of a rows x cols
+    grid with unit spacing — connected by construction (row-major prefixes
+    of a grid are connected).  Positions carry a small seeded jitter so the
+    geometry is non-degenerate for mobility/plotting; adjacency is the
+    exact lattice, independent of the jitter."""
+    rows = max(int(rows), 1)
+    cols = -(-n // rows)
+    adj = np.zeros((n, n), dtype=np.uint8)
+    for i in range(n):
+        r, c = divmod(i, cols)
+        if c + 1 < cols and i + 1 < n:          # east neighbor
+            adj[i, i + 1] = adj[i + 1, i] = 1
+        if i + cols < n:                        # south neighbor
+            adj[i, i + cols] = adj[i + cols, i] = 1
+    rng = np.random.default_rng(seed)
+    grid_pos = np.stack(
+        [np.arange(n) % cols, np.arange(n) // cols], axis=1
+    ).astype(np.float64)
+    pos = grid_pos + rng.uniform(-0.1, 0.1, (n, 2))
+    return adj, pos
+
+
+def grid_lattice(
+    n: int, aspect: float = 1.0, seed: int = 0
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Near-square planned lattice (warehouse / campus floor-plan layout);
+    `aspect` = rows/cols ratio of the bounding grid."""
+    if aspect <= 0:
+        raise ValueError("aspect must be positive")
+    rows = max(int(round(np.sqrt(n * aspect))), 1)
+    return _lattice(n, rows, seed=seed)
+
+
+def corridor(n: int, width: int = 2, seed: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+    """Long thin lattice (road segment / tunnel / assembly line): `width`
+    parallel lanes, length n/width — the maximum-diameter planned layout."""
+    if width < 1:
+        raise ValueError("width must be >= 1")
+    return _lattice(n, min(int(width), n), seed=seed)
+
+
+def two_tier(
+    n: int, clusters: int = 3, core: int = 2, p_in: float = 0.5,
+    seed: int = 0,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Clustered two-tier edge/cloud topology.
+
+    `core` cloud nodes form a clique; the remaining nodes split round-robin
+    into `clusters` edge clusters, each starred onto a cluster-head node
+    (connected by construction) plus random intra-cluster chords with
+    probability `p_in`; every cluster head uplinks to two cloud nodes
+    (or one, when `core == 1`).  Nodes 0..core-1 are the cloud tier;
+    nodes core..core+clusters-1 are the cluster heads — the heads
+    aggregate their cluster's star plus the cloud uplinks, so they end up
+    the highest-degree nodes and degree-ranked server placement puts the
+    compute at the edge gateways (traffic multihops through a head either
+    way, which is the regime the paper's policy is for).
+    """
+    if not 1 <= core < n:
+        raise ValueError("need 1 <= core < n")
+    clusters = max(1, min(int(clusters), n - core))
+    rng = np.random.default_rng(seed)
+    adj = np.zeros((n, n), dtype=np.uint8)
+    for a in range(core):           # cloud clique
+        for b in range(a + 1, core):
+            adj[a, b] = adj[b, a] = 1
+    members = [[] for _ in range(clusters)]
+    for i in range(core, n):        # round-robin edge membership
+        members[(i - core) % clusters].append(i)
+    for c, nodes in enumerate(members):
+        if not nodes:
+            continue
+        head = nodes[0]
+        for v in nodes[1:]:         # star onto the head: connectivity
+            adj[head, v] = adj[v, head] = 1
+        for ai in range(1, len(nodes)):     # random intra-cluster chords
+            for bi in range(ai + 1, len(nodes)):
+                if rng.random() < p_in:
+                    a, b = nodes[ai], nodes[bi]
+                    adj[a, b] = adj[b, a] = 1
+        up = (c % core, (c + 1) % core)     # head -> cloud gateways
+        for g in set(up):
+            adj[head, g] = adj[g, head] = 1
+    # geometry: cloud at the origin, clusters on a surrounding circle
+    pos = np.zeros((n, 2), dtype=np.float64)
+    pos[:core] = rng.uniform(-0.5, 0.5, (core, 2))
+    for c, nodes in enumerate(members):
+        theta = 2.0 * np.pi * c / clusters
+        center = 3.0 * np.array([np.cos(theta), np.sin(theta)])
+        pos[nodes] = center + rng.uniform(-0.8, 0.8, (len(nodes), 2))
+    return adj, pos
+
+
+# family registry: callable + the family-specific kwargs it accepts.
+# `generate` threads kwargs honestly — an unknown kwarg raises instead of
+# being silently dropped (the old dispatch swallowed `m` for grp/ws/er).
+_FAMILIES = {
+    "ba": (barabasi_albert, ("m",)),
+    "grp": (gaussian_random_partition, ("p_in", "p_out")),
+    "ws": (watts_strogatz, ("k", "p")),
+    "er": (erdos_renyi, ("degree",)),
+    "poisson": (poisson_disk, ("nb", "radius")),
+    "grid": (grid_lattice, ("aspect",)),
+    "corridor": (corridor, ("width",)),
+    "two_tier": (two_tier, ("clusters", "core", "p_in")),
+}
+
+# name -> callable(n, seed, **family_kwargs); kept as the public registry
 GENERATORS = {
-    "ba": lambda n, seed, m=2: barabasi_albert(n, m=m, seed=seed),
-    "grp": lambda n, seed, m=2: gaussian_random_partition(n, seed=seed),
-    "ws": lambda n, seed, m=2: watts_strogatz(n, seed=seed),
-    "er": lambda n, seed, m=2: erdos_renyi(n, seed=seed),
-    "poisson": lambda n, seed, m=2: poisson_disk(n, nb=m, seed=seed),
+    name: (lambda n, seed, _f=fn, **kw: _f(n, seed=seed, **kw))
+    for name, (fn, _) in _FAMILIES.items()
 }
 
 
-def generate(gtype: str, n: int, seed: int, m: int = 2):
-    """Dispatch on graph-family name (reference `offloading_v3.py:39-59`)."""
+def generate(gtype: str, n: int, seed: int, m: Optional[int] = None, **kwargs):
+    """Dispatch on graph-family name (reference `offloading_v3.py:39-59`).
+
+    `m` is the legacy density shorthand: BA attachment degree / Poisson
+    expected-neighbor count.  Passing it (or any kwarg) to a family that
+    does not take it raises — parameters are threaded honestly, never
+    silently dropped.
+    """
     gtype = gtype.lower()
-    if gtype not in GENERATORS:
-        raise ValueError(f"unsupported graph model '{gtype}'")
-    return GENERATORS[gtype](n, seed, m=m)
+    if gtype not in _FAMILIES:
+        raise ValueError(
+            f"unsupported graph model '{gtype}' "
+            f"(known: {', '.join(sorted(_FAMILIES))})"
+        )
+    fn, allowed = _FAMILIES[gtype]
+    if m is not None:
+        legacy = {"ba": "m", "poisson": "nb"}.get(gtype)
+        if legacy is None:
+            raise ValueError(
+                f"graph family '{gtype}' does not take the density "
+                f"parameter m; its parameters are {allowed or '()'}"
+            )
+        kwargs.setdefault(legacy, m)
+    unknown = sorted(set(kwargs) - set(allowed))
+    if unknown:
+        raise ValueError(
+            f"unknown parameter(s) {unknown} for graph family '{gtype}'; "
+            f"it takes {allowed or '()'}"
+        )
+    return fn(n, seed=seed, **kwargs)
 
 
 def spring_positions(

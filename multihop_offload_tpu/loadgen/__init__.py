@@ -20,6 +20,7 @@ from multihop_offload_tpu.loadgen.arrivals import (  # noqa: F401
     TrafficModel,
     arrival_times,
     poisson,
+    rate_profile,
 )
 from multihop_offload_tpu.loadgen.driver import (  # noqa: F401
     OpenLoopReport,

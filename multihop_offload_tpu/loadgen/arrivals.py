@@ -104,6 +104,42 @@ def _fast_at(path: List[Tuple[float, bool]], t: float) -> bool:
     return fast
 
 
+def rate_profile(
+    model: TrafficModel, duration_s: float, segments: int, seed: int,
+    normalize: bool = True, samples_per_segment: int = 32,
+) -> List[float]:
+    """Per-segment mean intensity multipliers of lambda(t) over
+    ``[0, duration_s)`` split into `segments` equal windows.
+
+    The MMPP state path is drawn from the seeded generator exactly as
+    `arrival_times` does, then each segment's mean of
+    ``rate_at(t) / base_rate`` is estimated on an even time grid — the
+    bridge from the continuous-time model to the simulator's per-segment
+    Bernoulli arrival probabilities (`scenarios.matrix` scales
+    ``SimParams.arr_p`` by these factors segment by segment).  With
+    `normalize=True` the multipliers are rescaled to mean 1, so a workload
+    pinned to a target utilization keeps that utilization as its horizon
+    MEAN while the shape (bursts, flashes, diurnal swing) moves around it.
+    Deterministic per (model, duration, segments, seed)."""
+    if duration_s <= 0 or segments < 1:
+        raise ValueError("need duration_s > 0 and segments >= 1")
+    rng = random.Random(int(seed))  # nondet-ok(explicitly seeded, same contract as arrival_times)
+    path = _mmpp_state_path(model, duration_s, rng)
+    seg_len = duration_s / segments
+    mults = []
+    for k in range(segments):
+        acc = 0.0
+        for i in range(samples_per_segment):
+            t = (k + (i + 0.5) / samples_per_segment) * seg_len
+            acc += model.rate_at(t, _fast_at(path, t)) / model.base_rate
+        mults.append(acc / samples_per_segment)
+    if normalize:
+        mean = sum(mults) / len(mults)
+        if mean > 0:
+            mults = [m / mean for m in mults]
+    return mults
+
+
 def arrival_times(
     model: TrafficModel, duration_s: float, seed: int
 ) -> List[float]:

@@ -1,0 +1,498 @@
+"""The scenario matrix: every preset through BOTH evaluators, one process.
+
+Modeled on the `mho-bench --matrix` interleaved-legs runner: one jax
+runtime, one shared `PadSpec` over every scenario, so ALL presets run
+through the same three compiled fleet programs (gnn / baseline / local)
+and the same jitted analytic evaluations — after the first leg the
+steady-state is declared and any further compilation is an UNEXPECTED
+retrace (asserted zero in the record).  The single exception is an
+energy-weighted objective: those weights are build-time constants closed
+over by the policy (`env.offloading.ObjectiveWeights`), so a spec with a
+nonzero objective genuinely needs its own programs — built inside
+`jaxhooks.expected_rebuild()`, the same convention `cli.bench` uses for
+its per-leg builds.
+
+Per scenario leg (all lanes vmapped in one program):
+
+  1. realize `scenario_fleet` seeded lanes (`scenarios.build.realize`);
+  2. pin the workload to the spec's utilization via the analytic
+     bottleneck (`sim.fidelity.scale_to_util`) — the traffic model then
+     modulates arrivals AROUND that mean, it never changes it;
+  3. analytic evaluation per policy (tau = mean per-job delay);
+  4. segmented packet simulation per policy: `scenario_segments`
+     sequential `FleetSim.run` calls on ONE executable, with per-segment
+     arrival scaling from `loadgen.rate_profile`, absolute-slot failure
+     schedules (`scenarios.build.failure_schedules`), and mobility
+     re-wiring + `sim.state.migrate_sim_state` queue migration at segment
+     boundaries — packet conservation stays EXACT through all of it
+     (asserted per lane);
+  5. GNN-vs-local-vs-greedy deltas on delivered ratio (sim) and tau
+     (analytic).
+
+The record also carries two `loop.drift.shift_campaign` rows — scenario
+switches rendered as shift injectors and pushed through the flywheel's
+drift detectors — closing the loop the ROADMAP's drift campaign needs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List
+
+import numpy as np
+
+from multihop_offload_tpu.config import Config
+
+_OUT_DEFAULT = "benchmarks/scenario_matrix.json"
+
+# the smoke drill's scenario subset: every NEW family, a reference family,
+# a failure schedule, and a mobility schedule — but no energy objective
+# (its expected_rebuild legs double the compile bill; the full matrix and
+# tests/test_scenarios.py cover the objective path)
+_SMOKE_SCENARIOS = ("ba_poisson", "grid_poisson", "corridor_links_fail",
+                    "two_tier_poisson", "poisson_mobility")
+_SMOKE_SHAPES = dict(scenario_fleet=2, scenario_segments=2,
+                     scenario_rounds=1, scenario_slots=120)
+
+POLICY_KINDS = ("gnn", "baseline", "local")
+
+
+def _traffic_axes(t) -> dict:
+    return {
+        "mmpp": t.mmpp_burst_factor > 1.0,
+        "diurnal": t.diurnal_amplitude > 0.0,
+        "flash": bool(t.flashes),
+    }
+
+
+def _obj_key(objective) -> tuple:
+    return (float(objective.transport_energy), float(objective.compute_energy))
+
+
+class _Programs:
+    """Every compiled artifact the legs share, keyed by objective weights.
+
+    The null-objective entry is built once up front; a nonzero objective
+    builds its own sims/evals lazily — callers wrap that first use in
+    `jaxhooks.expected_rebuild()`."""
+
+    def __init__(self, cfg: Config, pad, spec_sim, model, variables):
+        self.cfg = cfg
+        self.pad = pad
+        self.spec_sim = spec_sim
+        self.model = model
+        self.variables = variables
+        self.lay = cfg.layout_policy
+        self._sims: Dict[tuple, dict] = {}
+        self._evals: Dict[tuple, dict] = {}
+
+    def _build_analytic(self, objective) -> dict:
+        import jax
+        import jax.numpy as jnp
+
+        from multihop_offload_tpu.agent.actor import (
+            actor_delay_matrix,
+            default_support,
+        )
+        from multihop_offload_tpu.env.policies import (
+            baseline_policy,
+            evaluate_spmatrix_policy,
+            local_policy,
+        )
+        from multihop_offload_tpu.layouts import resolve_layout
+
+        lay, model, variables = self.lay, self.model, self.variables
+        obj = None if objective is None or objective.is_null else objective
+
+        def gnn_eval(inst, jobs, key):
+            # mirrors sim.policies' gnn_fn: same actor matrix, same layout
+            sup = default_support(model, inst, layout=lay)
+            actor = actor_delay_matrix(model, variables, inst, jobs, sup,
+                                       layout=lay)
+            if resolve_layout(lay).sparse:
+                unit_diag = jnp.where(inst.comp_mask, actor.node_delay,
+                                      jnp.inf)
+            else:
+                unit_diag = jnp.diagonal(actor.delay_matrix)
+            return evaluate_spmatrix_policy(
+                inst, jobs, actor.link_delay, unit_diag, key, layout=lay,
+                objective=obj,
+            )
+
+        return {
+            "gnn": jax.jit(gnn_eval),
+            "baseline": jax.jit(  # retrace-ok(built once per objective key, cached in _Programs._evals)
+                lambda i, j, k: baseline_policy(i, j, k, layout=lay,
+                                                objective=obj)
+            ),
+            "local": jax.jit(lambda i, j, k: local_policy(i, j, layout=lay)),  # retrace-ok(built once per objective key, cached in _Programs._evals)
+        }
+
+    def _build_sims(self, objective) -> dict:
+        from multihop_offload_tpu.sim.policies import make_policy
+        from multihop_offload_tpu.sim.runner import FleetSim
+
+        cfg, lay = self.cfg, self.lay
+        obj = None if objective is None or objective.is_null else objective
+        sims = {}
+        for kind in POLICY_KINDS:
+            if kind == "gnn":
+                pol = make_policy("gnn", model=self.model,
+                                  variables=self.variables,
+                                  precision=cfg.precision_policy, layout=lay,
+                                  objective=obj)
+            else:
+                pol = make_policy(kind, precision=cfg.precision_policy,
+                                  layout=lay, objective=obj)
+            sims[kind] = FleetSim(
+                self.spec_sim, pol, rounds=cfg.scenario_rounds,
+                slots_per_round=cfg.scenario_slots,
+            )
+        return sims
+
+    def is_new_objective(self, objective) -> bool:
+        return _obj_key(objective) not in self._sims
+
+    def get(self, objective):
+        """(sims dict, analytic-eval dict) for these objective weights."""
+        k = _obj_key(objective)
+        if k not in self._sims:
+            self._sims[k] = self._build_sims(objective)
+            self._evals[k] = self._build_analytic(objective)
+        return self._sims[k], self._evals[k]
+
+
+def _tau(outcome, jobs) -> float:
+    jt = np.asarray(outcome.job_total, np.float64)
+    mask = np.asarray(jobs.mask, bool)
+    return float(jt[mask].mean()) if mask.any() else 0.0
+
+
+def _run_leg(spec, cfg: Config, pad, spec_sim, programs: _Programs,
+             bp_pin) -> dict:
+    """One scenario through both evaluators; returns the record row."""
+    import jax
+    import jax.numpy as jnp
+
+    from multihop_offload_tpu.graphs.instance import stack_instances
+    from multihop_offload_tpu.loadgen.arrivals import rate_profile
+    from multihop_offload_tpu.scenarios.build import (
+        failure_schedules,
+        lane_seed,
+        mobility_step,
+        realize,
+    )
+    from multihop_offload_tpu.scenarios.spec import spec_hash
+    from multihop_offload_tpu.sim.fidelity import scale_to_util
+    from multihop_offload_tpu.sim.state import build_sim_params, migrate_sim_state
+
+    lay = cfg.layout_policy
+    fleet = cfg.scenario_fleet
+    segments = cfg.scenario_segments
+    seg_slots = cfg.scenario_rounds * cfg.scenario_slots
+    total_slots = segments * seg_slots
+
+    sims, evals = programs.get(spec.objective)
+
+    reals = [realize(spec, pad, lane=i, layout=lay) for i in range(fleet)]
+    keys = jax.random.split(jax.random.PRNGKey(spec.seed), fleet)
+
+    # pin the mean load to the spec's utilization (analytic bottleneck);
+    # the null-objective baseline prices the PHYSICAL load — objective
+    # weights bias decisions, never the load the pin is defined on
+    for i, r in enumerate(reals):
+        jobs_u, _ = scale_to_util(r.inst, r.jobs, keys[i], spec.util,
+                                  policy_fn=bp_pin)
+        reals[i] = dataclasses.replace(r, jobs=jobs_u)
+
+    analytic = {}
+    for kind in POLICY_KINDS:
+        taus = [
+            _tau(evals[kind](r.inst, r.jobs, keys[i]), r.jobs)
+            for i, r in enumerate(reals)
+        ]
+        analytic[kind] = {"tau": float(np.mean(taus)),
+                          "tau_per_lane": [round(t, 6) for t in taus]}
+
+    # dynamics schedules, shared by all three policies (identical worlds)
+    fails = [failure_schedules(spec, r, pad, total_slots, lane=i)
+             for i, r in enumerate(reals)]
+    params0 = [
+        build_sim_params(r.inst, r.jobs, margin=cfg.scenario_margin,
+                         fail_link_slot=fl, fail_node_slot=fn)
+        for r, (fl, fn) in zip(reals, fails)
+    ]
+    mults = [
+        rate_profile(spec.traffic, total_slots * float(p.dt), segments,
+                     seed=lane_seed(spec, i))
+        for i, p in enumerate(params0)
+    ]
+
+    sim_rows = {}
+    for p_idx, kind in enumerate(POLICY_KINDS):
+        sim = sims[kind]
+        cur = list(reals)
+        cur_params = list(params0)
+        mob_rngs = [np.random.default_rng(lane_seed(spec, i) + 2)
+                    for i in range(fleet)]
+        seg_keys = jax.random.split(
+            jax.random.PRNGKey(spec.seed + 7919 * (p_idx + 1)),
+            segments * fleet,
+        ).reshape(segments, fleet, -1)
+        states = None
+        init_rates = jnp.stack([r.jobs.rate for r in cur])
+        migrated_drops = 0
+        for seg in range(segments):
+            paramss = stack_instances([
+                p.replace(arr_p=jnp.clip(
+                    jnp.asarray(p.arr_p) * mults[i][seg], 0.0, 1.0))
+                for i, p in enumerate(cur_params)
+            ])
+            run = sim.run(
+                stack_instances([r.inst for r in cur]),
+                stack_instances([r.jobs for r in cur]),
+                paramss, seg_keys[seg],
+                states=states, init_rates=init_rates,
+            )
+            states = run.state
+            # freshest empirical rate estimate seeds the next segment's
+            # first policy round (closed-loop continuation across segments)
+            init_rates = run.est_rates[:, -1, :]
+            if spec.mobility is not None and seg < segments - 1:
+                st_host = jax.tree_util.tree_map(np.asarray, states)
+                new_states = []
+                for i in range(fleet):
+                    before = int(st_host.dropped[i].sum())
+                    new_r, link_map = mobility_step(
+                        spec, cur[i], pad, layout=lay, rng=mob_rngs[i]
+                    )
+                    cur[i] = new_r
+                    cur_params[i] = build_sim_params(
+                        new_r.inst, new_r.jobs, margin=cfg.scenario_margin,
+                        fail_link_slot=fails[i][0],
+                        fail_node_slot=fails[i][1],
+                    )
+                    st_i = jax.tree_util.tree_map(
+                        lambda x: x[i], st_host)
+                    st_m = migrate_sim_state(st_i, link_map, spec_sim)
+                    migrated_drops += int(
+                        np.asarray(st_m.dropped).sum()) - before
+                    new_states.append(st_m)
+                # stack on the host and device_put: a pure transfer, so the
+                # re-wiring never traces anything after mark_steady
+                states = jax.tree_util.tree_map(
+                    lambda *xs: jnp.asarray(np.stack(
+                        [np.asarray(x) for x in xs])),
+                    *new_states,
+                )
+
+        st = jax.tree_util.tree_map(np.asarray, states)
+        generated = st.generated.sum(axis=1)
+        delivered = st.delivered.sum(axis=1)
+        dropped = st.dropped.sum(axis=1)
+        in_flight = st.count[:, :-1].sum(axis=1)
+        gap = generated - delivered - dropped - in_flight
+        j = spec_sim.num_jobs
+        dt = np.asarray([float(p.dt) for p in cur_params])
+        with np.errstate(divide="ignore", invalid="ignore"):
+            mean_delay = np.where(
+                st.delivered > 0,
+                st.delay_sum / np.maximum(st.delivered, 1), np.nan
+            ) * dt[:, None]
+        sim_rows[kind] = {
+            "generated": int(generated.sum()),
+            "delivered": int(delivered.sum()),
+            "dropped": int(dropped.sum()),
+            "in_flight": int(in_flight.sum()),
+            "conservation_gap": int(np.abs(gap).sum()),
+            "conservation_ok": bool((gap == 0).all()),
+            "delivered_ratio": float(delivered.sum()
+                                     / max(int(generated.sum()), 1)),
+            "mean_packet_delay": float(np.nanmean(mean_delay[:, :j]))
+            if np.isfinite(mean_delay[:, :j]).any() else None,
+            "migration_drops": migrated_drops,
+        }
+
+    dr = {k: sim_rows[k]["delivered_ratio"] for k in POLICY_KINDS}
+    tau = {k: analytic[k]["tau"] for k in POLICY_KINDS}
+    deltas = {
+        "delivered_ratio_gnn_minus_greedy": round(dr["gnn"] - dr["baseline"], 6),
+        "delivered_ratio_gnn_minus_local": round(dr["gnn"] - dr["local"], 6),
+        "tau_ratio_gnn_over_greedy": round(tau["gnn"] / tau["baseline"], 6)
+        if tau["baseline"] > 0 else None,
+        "tau_ratio_gnn_over_local": round(tau["gnn"] / tau["local"], 6)
+        if tau["local"] > 0 else None,
+    }
+    return {
+        "name": spec.name,
+        "hash": spec_hash(spec),
+        "family": spec.family,
+        "n_nodes": spec.n_nodes,
+        "axes": {
+            "traffic": _traffic_axes(spec.traffic),
+            "mu_spread": spec.mu_spread,
+            "failures": [dataclasses.asdict(f) for f in spec.failures],
+            "mobility": spec.mobility is not None,
+            "objective": dataclasses.asdict(spec.objective),
+        },
+        "util": spec.util,
+        "lanes": fleet,
+        "slots": total_slots,
+        "segments": segments,
+        "analytic": analytic,
+        "sim": sim_rows,
+        "deltas": deltas,
+        "conservation_ok": all(sim_rows[k]["conservation_ok"]
+                               for k in POLICY_KINDS),
+    }
+
+
+def _shift_drift_rows(specs: Dict[str, object], ticks: int = 96,
+                      at_tick: int = 32) -> List[dict]:
+    """Two scenario switches through the drift detectors: a traffic-shape
+    shift (flash crowd arrives) and an objective shift (energy price moves
+    the offload fraction)."""
+    from multihop_offload_tpu.loop.drift import shift_campaign
+    from multihop_offload_tpu.scenarios.shift import shift
+
+    pairs = [("ba_poisson", "grp_flash"), ("grid_poisson", "grid_energy")]
+    rows = []
+    for a, b in pairs:
+        if a in specs and b in specs:
+            rows.append(shift_campaign(shift(specs[a], specs[b], at_tick),
+                                       ticks))
+    return rows
+
+
+def run_matrix(cfg: Config, smoke: bool) -> dict:
+    """The campaign; returns the JSON-ready record (asserts under smoke)."""
+    import sys
+
+    import jax
+
+    from multihop_offload_tpu.cli.sim import load_gnn
+    from multihop_offload_tpu.env.policies import baseline_policy
+    from multihop_offload_tpu.graphs.instance import PadSpec
+    from multihop_offload_tpu.obs import jaxhooks
+    from multihop_offload_tpu.scenarios import presets as presets_mod
+    from multihop_offload_tpu.scenarios.build import draw_topology
+    from multihop_offload_tpu.sim.state import spec_for
+
+    jaxhooks.install()
+    if smoke:
+        cfg = dataclasses.replace(cfg, **_SMOKE_SHAPES)
+        names = list(_SMOKE_SCENARIOS)
+    elif cfg.scenario_names:
+        names = [n.strip() for n in cfg.scenario_names.split(",") if n.strip()]
+    else:
+        names = presets_mod.preset_names()
+    specs = [presets_mod.preset(n) for n in names]
+
+    lay = cfg.layout_policy
+    fleet = cfg.scenario_fleet
+
+    # ONE pad over every scenario and lane: the shared static shape that
+    # lets all presets reuse the same compiled programs
+    from multihop_offload_tpu.graphs.topology import build_topology
+
+    max_n, max_l, max_j = 0, 0, 0
+    for s in specs:
+        for i in range(fleet):
+            adj, pos = draw_topology(s, lane=i)
+            max_l = max(max_l, build_topology(adj, pos=pos).num_links)
+        max_n = max(max_n, s.n_nodes)
+        max_j = max(max_j, s.num_jobs)
+    rt = cfg.round_to
+    pad = PadSpec(n=-(-max_n // rt) * rt, l=-(-max_l // rt) * rt, s=rt,
+                  j=max(max_j, rt))
+
+    model, variables = load_gnn(cfg, pad)
+
+    # the util pin's analytic baseline (null objective, shared everywhere)
+    bp_pin = jax.jit(  # retrace-ok(one pin program per run_matrix call, reused by every leg)
+        lambda i, j, k: baseline_policy(i, j, k, layout=lay))
+
+    # a probe realization defines the shared SimSpec (pad-derived, so any
+    # lane of any scenario produces the identical spec)
+    from multihop_offload_tpu.scenarios.build import realize
+
+    probe = realize(specs[0], pad, lane=0, layout=lay)
+    spec_sim = spec_for(probe.inst, probe.jobs, cap=cfg.scenario_cap)
+    programs = _Programs(cfg, pad, spec_sim, model, variables)
+    programs.get(presets_mod.preset("ba_poisson").objective)  # null build
+
+    rows = []
+    first = True
+    for s in specs:
+        print(f"[scenario-matrix] leg {s.name} ...", file=sys.stderr)  # print-ok(operator progress line on stderr, mirrors cli.bench's leg banner)
+        if programs.is_new_objective(s.objective):
+            # nonzero objective weights are build-time constants: these
+            # programs are genuinely new, never an unexpected retrace
+            with jaxhooks.expected_rebuild():
+                rows.append(_run_leg(s, cfg, pad, spec_sim, programs,
+                                     bp_pin))
+        else:
+            rows.append(_run_leg(s, cfg, pad, spec_sim, programs, bp_pin))
+        if first:
+            sims, _ = programs.get(s.objective)
+            for sim in sims.values():
+                sim.mark_steady()
+            jaxhooks.mark_steady()
+            first = False
+
+    all_specs = {n: presets_mod.preset(n) for n in presets_mod.preset_names()}
+    shift_rows = _shift_drift_rows(all_specs)
+
+    retraces = jaxhooks.unexpected_retraces()
+    families = sorted({r["family"] for r in rows})
+    record = {
+        "description": "mho-scenarios --matrix: every scenario preset "
+                       "through the analytic evaluator AND the packet-level "
+                       "FleetSim in one process — one shared pad, three "
+                       "compiled fleet programs reused across all legs, "
+                       "per-scenario GNN-vs-local-vs-greedy deltas, exact "
+                       "packet conservation, scenario-shift drift rows",
+        "generated_by": "python -m multihop_offload_tpu.cli.scenarios "
+                        "--matrix" + (" --smoke" if smoke else ""),
+        "platform": jax.default_backend(),
+        "smoke": smoke,
+        "config": {
+            "fleet_lanes": fleet,
+            "segments": cfg.scenario_segments,
+            "rounds_per_segment": cfg.scenario_rounds,
+            "slots_per_round": cfg.scenario_slots,
+            "cap": cfg.scenario_cap,
+            "margin": cfg.scenario_margin,
+            "pad": {"n": pad.n, "l": pad.l, "s": pad.s, "j": pad.j},
+            "policies": list(POLICY_KINDS),
+        },
+        "scenarios": rows,
+        "families": families,
+        "new_families_covered": [f for f in presets_mod.NEW_FAMILIES
+                                 if f in families],
+        "shift_drift": shift_rows,
+        "conservation_ok_all": all(r["conservation_ok"] for r in rows),
+        "unexpected_retraces": retraces,
+    }
+
+    if smoke:
+        checks = {
+            "all_legs_ran": len(rows) == len(names),
+            "both_paths_per_scenario": all(
+                set(r["analytic"]) == set(POLICY_KINDS)
+                and set(r["sim"]) == set(POLICY_KINDS) for r in rows),
+            "conservation_exact": record["conservation_ok_all"],
+            "new_families_covered": set(record["new_families_covered"])
+            == set(presets_mod.NEW_FAMILIES),
+            "packets_flowed": all(
+                r["sim"][k]["generated"] > 0 and r["sim"][k]["delivered"] > 0
+                for r in rows for k in POLICY_KINDS),
+            "shift_drift_detected": all(
+                s["detected"] and not s["false_positive"]
+                for s in shift_rows),
+            "no_unexpected_retraces": retraces == 0,
+        }
+        record["checks"] = checks
+        record["ok"] = all(checks.values())
+        assert record["ok"], f"scenario matrix smoke failed: {checks}"
+    return record

@@ -54,6 +54,7 @@ def decide_routes(
     prob: bool = False,
     apsp_fn=None,
     layout=None,
+    objective=None,
 ) -> SimRoutes:
     """Shared decision skeleton on arbitrary unit delays (the sim-side twin
     of `evaluate_spmatrix_policy`, returning the forwarding table instead
@@ -74,7 +75,8 @@ def decide_routes(
         )
     sp = (apsp_fn or apsp_minplus)(w)
     dec = offload_decide(
-        inst, jobs_est, sp, inst.hop, unit_diag, key, explore, prob
+        inst, jobs_est, sp, inst.hop, unit_diag, key, explore, prob,
+        objective=objective,
     )
     # a destination that became unreachable (failure cut the graph) degrades
     # to local compute — packets must never chase an infinite-cost route
@@ -102,6 +104,7 @@ def make_policy(
     fp_fn=None,
     precision=None,
     layout=None,
+    objective=None,
 ):
     """Build the per-round policy function for `sim.runner.simulate`.
 
@@ -111,6 +114,9 @@ def make_policy(
     The decision read-back stays an fp32 island (`env.offloading`).
     `layout` follows the same contract: resolved once, closed over, and the
     instances fed to the returned function must have been built with it.
+    `objective` (`env.offloading.ObjectiveWeights` | None) folds energy/cost
+    weights into the decision's cost table — plain floats, closed over like
+    the other build-time knobs; None/all-zero is bit-identical to today.
     """
     from multihop_offload_tpu.precision import resolve_precision
 
@@ -138,6 +144,7 @@ def make_policy(
             return decide_routes(
                 inst, jobs_est, link_d, node_d, node_up, link_up, key,
                 explore=explore, prob=prob, apsp_fn=apsp_fn, layout=lay,
+                objective=objective,
             )
 
         return baseline_fn
@@ -164,6 +171,7 @@ def make_policy(
             inst, jobs_est, actor.link_delay, unit_diag,
             node_up, link_up, key,
             explore=explore, prob=prob, apsp_fn=apsp_fn, layout=lay,
+            objective=objective,
         )
 
     return gnn_fn

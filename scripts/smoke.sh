@@ -5,7 +5,7 @@
 #
 # Chains (each must pass; total budget a few minutes on a CPU host):
 #   1. bash scripts/lint.sh          — ruff (or the engine's pyflakes set)
-#      plus the repo's JAX-aware rules (JX001-JX010, MP001, SL001,
+#      plus the repo's JAX-aware rules (JX001-JX011, MP001, SL001,
 #      OB001-OB003);
 #   2. mho-lint --json               — the static-analysis engine alone,
 #      proving the JSON surface and the seeded-violation fixture dir
@@ -75,7 +75,14 @@
 #      labeled fleet counters, a whole host SIGKILLed mid-run -> forced
 #      replan onto the survivor with conservation and zero unexpected
 #      retraces, and an open-loop bisection committing the max sustained
-#      req/s at the p99 SLO; writes benchmarks/mesh_smoke.json.
+#      req/s at the p99 SLO; writes benchmarks/mesh_smoke.json;
+#  14. mho-scenarios --matrix --smoke — the scenario-matrix drill (<90 s):
+#      a preset subset covering every NEW topology family (grid, corridor,
+#      two-tier edge-cloud) plus a failure schedule and a mobility leg,
+#      each through BOTH the analytic evaluator and FleetSim with exact
+#      packet conservation, traffic-model rate profiles applied per
+#      segment, shift-injector drift detection (no false positives), and
+#      zero unexpected retraces; writes benchmarks/scenario_smoke.json.
 #
 # This is the tier-1-ADJACENT gate (ROADMAP "quick checks") — it does not
 # replace the pytest tier-1 run.
@@ -84,10 +91,10 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
-echo "== [1/13] lint =="
+echo "== [1/14] lint =="
 bash scripts/lint.sh
 
-echo "== [2/13] mho-lint (engine: clean repo + every rule fires on seeds) =="
+echo "== [2/14] mho-lint (engine: clean repo + every rule fires on seeds) =="
 python -m multihop_offload_tpu.analysis.cli --json >/dev/null
 python - <<'EOF'
 import json, subprocess, sys
@@ -96,14 +103,14 @@ out = subprocess.run(
      "tests/fixtures/analysis_seeded"], capture_output=True, text=True)
 fired = {f["rule"] for f in json.loads(out.stdout)["findings"]}
 need = {"JX001", "JX002", "JX003", "JX004", "JX005", "JX006", "JX007",
-        "JX008", "JX009", "JX010", "MP001", "SL001", "OB001", "OB002",
-        "OB003"}
+        "JX008", "JX009", "JX010", "JX011", "MP001", "SL001", "OB001",
+        "OB002", "OB003"}
 missing = sorted(need - fired)
 assert not missing, f"rules silent on their seeded violations: {missing}"
 print(f"mho-lint: all {len(need)} repo rules fire on the seeded fixtures")
 EOF
 
-echo "== [3/13] mho-sim --smoke (+ device metrics in the run report) =="
+echo "== [3/14] mho-sim --smoke (+ device metrics in the run report) =="
 SIM_LOG="$(mktemp -d)/run.jsonl"
 python -m multihop_offload_tpu.cli.sim --smoke --obs_log "$SIM_LOG"
 python - "$SIM_LOG" <<'EOF'
@@ -131,22 +138,22 @@ assert host == dev, f"devmetrics diverge from SimState: host={host} dev={dev}"
 print(f"devmetrics == SimState: {host} (exact), report section present")
 EOF
 
-echo "== [4/13] mho-sim --smoke --layout sparse =="
+echo "== [4/14] mho-sim --smoke --layout sparse =="
 python -m multihop_offload_tpu.cli.sim --smoke --layout sparse
 
-echo "== [5/13] mho-loop --smoke =="
+echo "== [5/14] mho-loop --smoke =="
 python -m multihop_offload_tpu.cli.loop --smoke
 
-echo "== [6/13] mho-chaos --smoke =="
+echo "== [6/14] mho-chaos --smoke =="
 python -m multihop_offload_tpu.cli.chaos --smoke
 
-echo "== [7/13] mho-health --smoke =="
+echo "== [7/14] mho-health --smoke =="
 python -m multihop_offload_tpu.cli.health --smoke
 
-echo "== [8/13] mho-prof --smoke =="
+echo "== [8/14] mho-prof --smoke =="
 python -m multihop_offload_tpu.cli.prof --smoke
 
-echo "== [9/13] sharded serve smoke (8 virtual devices) =="
+echo "== [9/14] sharded serve smoke (8 virtual devices) =="
 XLA_FLAGS="--xla_force_host_platform_device_count=8" python - <<'PYEOF'
 from multihop_offload_tpu.cli.serve import build_service
 from multihop_offload_tpu.config import Config
@@ -165,22 +172,27 @@ print(f"sharded serve: {len(responses)} requests over {used} devices, "
       f"placement {service.planner.plan.describe()}")
 PYEOF
 
-echo "== [10/13] mho-bench --matrix --smoke =="
+echo "== [10/14] mho-bench --matrix --smoke =="
 # refreshes the committed benchmarks/bench_matrix.json (the CPU record IS
 # the committed artifact until a chip session fills the on-chip gates)
 python -m multihop_offload_tpu.cli.bench --matrix --smoke
 
-echo "== [11/13] mho-fuzz --smoke =="
+echo "== [11/14] mho-fuzz --smoke =="
 python -m multihop_offload_tpu.cli.fuzz --smoke
 
-echo "== [12/13] mho-rl --smoke =="
+echo "== [12/14] mho-rl --smoke =="
 # refreshes the committed benchmarks/rl_smoke.json (the CPU episodes/s
 # record is the baseline for the on-chip >=127K/chip gate)
 python -m multihop_offload_tpu.cli.rl --smoke
 
-echo "== [13/13] mho-mesh --smoke (2-process mesh federation) =="
+echo "== [13/14] mho-mesh --smoke (2-process mesh federation) =="
 # refreshes the committed benchmarks/mesh_smoke.json (CPU two-process
 # proof; a chip fleet re-runs the same gate over real hosts)
 python -m multihop_offload_tpu.cli.mesh --smoke
+
+echo "== [14/14] mho-scenarios --matrix --smoke =="
+# refreshes the committed benchmarks/scenario_smoke.json (the full-matrix
+# benchmarks/scenario_matrix.json is refreshed by `mho-scenarios --matrix`)
+python -m multihop_offload_tpu.cli.scenarios --matrix --smoke
 
 echo "smoke: all green"

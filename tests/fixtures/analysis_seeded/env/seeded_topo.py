@@ -1,0 +1,30 @@
+"""Seeded violations: JX011 (raw networkx topology draws outside graphs/).
+
+Three spellings of the ad-hoc draw — a `*_graph` family constructor, an
+aliased import, and a bare `nx.Graph()` hand-build — plus one waived
+line proving the `# topo-ok(<why>)` escape hatch suppresses a finding
+without silencing the rest.
+"""
+
+import networkx as nx
+from networkx import barabasi_albert_graph
+
+
+def adhoc_family_draw(n: int, m: int, seed: int):
+    # JX011: skips the connectivity retry and (adj, pos) contract that
+    # graphs.generators.generate owns
+    return nx.barabasi_albert_graph(n, m, seed=seed)
+
+
+def adhoc_aliased_draw(n: int, seed: int):
+    return barabasi_albert_graph(n, 2, seed=seed)  # JX011: alias resolves
+
+
+def hand_built():
+    g = nx.Graph()  # JX011: hand-built container, same hazard
+    g.add_edge(0, 1)
+    return g
+
+
+def waived_draw(n: int):
+    return nx.path_graph(n)  # topo-ok(fixture: reviewed doc example, not a sim topology)

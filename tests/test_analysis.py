@@ -21,8 +21,8 @@ from multihop_offload_tpu.analysis.cli import main as lint_main
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SEEDED = os.path.join(REPO, "tests", "fixtures", "analysis_seeded")
 ALL_REPO_RULES = {"JX001", "JX002", "JX003", "JX004", "JX005", "JX006",
-                  "JX007", "JX008", "JX009", "JX010", "MP001", "SL001",
-                  "OB001", "OB002", "OB003"}
+                  "JX007", "JX008", "JX009", "JX010", "JX011", "MP001",
+                  "SL001", "OB001", "OB002", "OB003"}
 
 
 def run_on(tmp_path, files, select=None, baseline=None):
@@ -631,6 +631,45 @@ def test_jx010_exempts_multihost(tmp_path):
     assert "JX010" not in rules_hit(rep)
     rep = run_on(tmp_path, {"serve/m.py": src})
     assert "JX010" in rules_hit(rep)
+
+
+def test_jx011_tp_waived_and_fp_guard(tmp_path):
+    rep = run_on(tmp_path, {"scenarios/m.py": """\
+        import networkx as nx
+        from networkx import watts_strogatz_graph
+
+        def tp_family(n, seed):
+            return nx.barabasi_albert_graph(n, 2, seed=seed)
+
+        def tp_alias(n, seed):
+            return watts_strogatz_graph(n, 4, 0.2, seed=seed)
+
+        def tp_container():
+            return nx.Graph()
+
+        def waived(n):
+            return nx.path_graph(n)  # topo-ok(doc example, not a sim topology)
+
+        def clean(g):
+            # reads/algorithms on an existing graph are not draws
+            return nx.is_connected(g), g.subgraph([0, 1])
+    """})
+    jx = [f for f in rep.findings if f.rule == "JX011"]
+    assert [f.line for f in jx] == [5, 8, 11]
+    assert len([f for f in rep.waived if f.rule == "JX011"]) == 1
+
+
+def test_jx011_exempts_graphs_dir(tmp_path):
+    src = """\
+        import networkx as nx
+
+        def draw(n, seed):
+            return nx.barabasi_albert_graph(n, 2, seed=seed)
+    """
+    rep = run_on(tmp_path, {"graphs/generators.py": src})
+    assert "JX011" not in rules_hit(rep)
+    rep = run_on(tmp_path, {"env/m.py": src})
+    assert "JX011" in rules_hit(rep)
 
 
 # ---------------------------------------------------------------------------

@@ -1,5 +1,7 @@
 """Graph layer: topology arrays vs NetworkX oracles, padding, .mat IO."""
 
+import warnings
+
 import networkx as nx
 import numpy as np
 import pytest
@@ -144,6 +146,80 @@ def test_generators_shapes():
         assert (adj == adj.T).all() and (np.diag(adj) == 0).all()
     adj, pos, nb = generators.connected_poisson_disk(25, seed=3)
     assert nx.is_connected(nx.from_numpy_array(adj))
+
+
+@pytest.mark.parametrize("name", ["grid", "corridor", "two_tier"])
+@pytest.mark.parametrize("seed", [0, 11])
+def test_new_families_connected_deterministic_and_contract(name, seed):
+    """The scenario matrix's planned-deployment families: connected by
+    construction, deterministic per seed, and honoring the (adj, pos)
+    shape/dtype contract every family shares."""
+    n = 18
+    adj, pos = generators.generate(name, n, seed=seed)
+    assert adj.shape == (n, n) and adj.dtype == np.uint8
+    assert (adj == adj.T).all() and (np.diag(adj) == 0).all()
+    assert set(np.unique(adj)) <= {0, 1}
+    assert pos is not None and pos.shape == (n, 2)
+    assert np.issubdtype(pos.dtype, np.floating)
+    assert nx.is_connected(nx.from_numpy_array(adj))
+    adj2, pos2 = generators.generate(name, n, seed=seed)
+    np.testing.assert_array_equal(adj, adj2)
+    np.testing.assert_array_equal(pos, pos2)
+    adj3, _ = generators.generate(name, n, seed=seed + 1)
+    if name == "two_tier":  # lattices are seed-independent in adjacency
+        assert not np.array_equal(adj, adj3)
+
+
+def test_corridor_and_grid_shape_knobs():
+    adj_c, _ = generators.generate("corridor", 16, seed=0, width=2)
+    adj_g, _ = generators.generate("grid", 16, seed=0)
+    g_c = nx.from_numpy_array(adj_c)
+    g_g = nx.from_numpy_array(adj_g)
+    # a 2-wide corridor is strictly longer end to end than a square grid
+    assert nx.diameter(g_c) > nx.diameter(g_g)
+
+
+def test_two_tier_cluster_heads_are_highest_degree():
+    """Degree-ranked placement (the scenario builder's rule) must land on
+    the cluster heads — the edge gateways every cluster multihops through
+    (nodes core..core+clusters-1 by construction)."""
+    core, clusters = 2, 3
+    adj, _ = generators.generate("two_tier", 17, seed=3, core=core,
+                                 clusters=clusters)
+    deg = adj.sum(axis=1)
+    ranked = np.argsort(-deg, kind="stable")[:clusters]
+    assert set(int(r) for r in ranked) == set(range(core, core + clusters))
+
+
+def test_er_grp_retry_to_connected_with_typed_warning():
+    """Sparse nominal parameters force the densify-retry: the draw still
+    comes back connected and the typed warning marks the fallback."""
+    for fam, kwargs in [("er", {"degree": 1.2}), ("grp", {"p_in": 0.05,
+                                                          "p_out": 0.01})]:
+        hit = False
+        for seed in range(20):
+            with warnings.catch_warnings(record=True) as w:
+                warnings.simplefilter("always")
+                adj, _ = generators.generate(fam, 24, seed=seed, **kwargs)
+            assert nx.is_connected(nx.from_numpy_array(adj)), (fam, seed)
+            if any(issubclass(x.category,
+                              generators.DisconnectedGraphWarning)
+                   for x in w):
+                hit = True
+                break
+        assert hit, f"{fam}: no draw engaged the retry fallback in 20 seeds"
+
+
+def test_generate_rejects_unknown_family_and_dishonest_kwargs():
+    with pytest.raises(ValueError, match="unsupported graph model"):
+        generators.generate("smallworld", 16, seed=0)
+    # the legacy density shorthand only maps onto ba/poisson
+    with pytest.raises(ValueError, match="does not take the density"):
+        generators.generate("ws", 16, seed=0, m=3)
+    with pytest.raises(ValueError, match="unknown parameter"):
+        generators.generate("grid", 16, seed=0, width=2)
+    adj, _ = generators.generate("ba", 16, seed=0, m=3)
+    assert adj.sum() // 2 == (16 - 3) * 3  # m threads through for ba
 
 
 def test_spring_positions_cache(tmp_path):

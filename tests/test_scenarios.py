@@ -1,0 +1,300 @@
+"""scenarios/ subsystem: spec round-trip + hash, preset determinism,
+realization axes (heterogeneous mu, correlated failures, mobility),
+analytic-vs-sim agreement at low rho for EVERY topology family (one
+compiled fleet program, one lane per family), and the shift-injector /
+drift-campaign semantics.
+"""
+
+import dataclasses
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from multihop_offload_tpu.env.policies import baseline_policy
+from multihop_offload_tpu.graphs.instance import PadSpec, stack_instances
+from multihop_offload_tpu.loop.drift import shift_campaign
+from multihop_offload_tpu.scenarios import (
+    NEW_FAMILIES,
+    PRESETS,
+    ScenarioSpec,
+    from_json,
+    preset,
+    preset_names,
+    shift,
+    spec_hash,
+    to_json,
+)
+from multihop_offload_tpu.scenarios.build import (
+    draw_topology,
+    failure_schedules,
+    mobility_step,
+    realize,
+)
+from multihop_offload_tpu.sim.fidelity import (
+    analytic_link_delay,
+    empirical_queue_delays,
+    scale_to_util,
+)
+from multihop_offload_tpu.sim.policies import make_policy
+from multihop_offload_tpu.sim.runner import FleetSim
+from multihop_offload_tpu.sim.state import build_sim_params, spec_for
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MATRIX_RECORD = os.path.join(REPO, "benchmarks", "scenario_matrix.json")
+
+# one representative preset per family (the low-rho fidelity fleet)
+FAMILY_REPS = {
+    "ba": "ba_poisson",
+    "ws": "ws_diurnal",
+    "er": "er_hetero",
+    "grp": "grp_flash",
+    "poisson": "poisson_mobility",
+    "grid": "grid_poisson",
+    "corridor": "corridor_mmpp",
+    "two_tier": "two_tier_poisson",
+}
+
+
+def _shared_pad(specs, lanes=1, round_to=8):
+    max_n = max(s.n_nodes for s in specs)
+    max_j = max(s.num_jobs for s in specs)
+    max_l = 0
+    for s in specs:
+        for i in range(lanes):
+            adj, _ = draw_topology(s, lane=i)
+            max_l = max(max_l, int(np.triu(adj, 1).sum()))
+    rt = round_to
+    return PadSpec(n=-(-max_n // rt) * rt, l=-(-max_l // rt) * rt, s=rt,
+                   j=max(max_j, rt))
+
+
+# ---------------------------------------------------------------------------
+# spec: JSON round-trip, hash, validation
+# ---------------------------------------------------------------------------
+
+
+def test_every_preset_round_trips_and_hash_is_content_stable():
+    for name in preset_names():
+        s = preset(name)
+        rt = from_json(to_json(s))
+        assert rt == s, name
+        h = spec_hash(s)
+        assert h == spec_hash(rt) == spec_hash(s)  # pure content hash
+        assert len(h) == 12 and int(h, 16) >= 0
+    # the hash keys on content: any field change moves it, including name
+    a = preset("ba_poisson")
+    assert spec_hash(dataclasses.replace(a, seed=a.seed + 1)) != spec_hash(a)
+    assert spec_hash(dataclasses.replace(a, name="renamed")) != spec_hash(a)
+
+
+def test_committed_matrix_record_hashes_match_the_registry():
+    """The committed record rows carry each spec's content hash — editing a
+    preset without re-running `mho-scenarios --matrix` breaks this."""
+    with open(MATRIX_RECORD) as f:
+        record = json.load(f)
+    assert len(record["scenarios"]) >= 12
+    for row in record["scenarios"]:
+        assert row["hash"] == spec_hash(preset(row["name"])), row["name"]
+    assert set(record["new_families_covered"]) == set(NEW_FAMILIES)
+
+
+def test_spec_validation_rejects_bad_worlds():
+    with pytest.raises(ValueError, match="unknown topology family"):
+        ScenarioSpec(name="x", family="smallworld")
+    with pytest.raises(ValueError, match="util"):
+        ScenarioSpec(name="x", util=1.5)
+    with pytest.raises(ValueError, match="geometric"):
+        # mobility needs coordinates; BA has none
+        from multihop_offload_tpu.scenarios import MobilitySpec
+        ScenarioSpec(name="x", family="ba", mobility=MobilitySpec())
+    with pytest.raises(KeyError, match="unknown scenario preset"):
+        preset("nope")
+
+
+def test_registry_covers_new_families_and_axes():
+    fams = {s.family for s in PRESETS.values()}
+    assert set(NEW_FAMILIES) <= fams
+    assert any(s.mu_spread > 0 for s in PRESETS.values())
+    assert any(s.failures for s in PRESETS.values())
+    assert any(s.mobility is not None for s in PRESETS.values())
+    assert any(not s.objective.is_null for s in PRESETS.values())
+
+
+# ---------------------------------------------------------------------------
+# build: determinism, heterogeneous mu, failure/mobility schedules
+# ---------------------------------------------------------------------------
+
+
+def test_realize_deterministic_per_seed_and_lane():
+    s = preset("grid_poisson")
+    pad = _shared_pad([s])
+    a = realize(s, pad, lane=0)
+    b = realize(s, pad, lane=0)
+    np.testing.assert_array_equal(a.topo.adj, b.topo.adj)
+    np.testing.assert_array_equal(np.asarray(a.inst.link_rates),
+                                  np.asarray(b.inst.link_rates))
+    np.testing.assert_array_equal(np.asarray(a.jobs.src),
+                                  np.asarray(b.jobs.src))
+    np.testing.assert_array_equal(a.proc_bws, b.proc_bws)
+    # a different lane is a different seeded world (positions jitter even
+    # on the lattice families)
+    c = realize(s, pad, lane=1)
+    assert not np.array_equal(a.pos, c.pos)
+
+
+def test_heterogeneous_mu_is_a_seeded_spread():
+    pad = _shared_pad([preset("er_hetero"), preset("ba_poisson")])
+    het = realize(preset("er_hetero"), pad, lane=0)
+    servers = set(int(x) for x in het.servers)
+    srv = np.array([het.proc_bws[i] for i in servers])
+    assert np.unique(np.round(srv, 9)).size == len(servers)  # spread, not nominal
+    hom = realize(preset("ba_poisson"), pad, lane=0)
+    expect = np.where(np.isin(np.arange(16), hom.servers), 100.0, 8.0)
+    np.testing.assert_allclose(hom.proc_bws, expect)
+
+
+def test_failure_schedules_links_and_blast_semantics():
+    total = 400
+    s = preset("corridor_links_fail")
+    pad = _shared_pad([s, preset("ba_blast")])
+    r = realize(s, pad, lane=0)
+    fl, fn = failure_schedules(s, r, pad, total, lane=0)
+    assert fl.shape == (pad.l,) and fn.shape == (pad.n,)
+    assert fl.dtype == np.int32 and fn.dtype == np.int32
+    hit = np.flatnonzero(fl >= 0)
+    assert hit.size == 2 and (fl[hit] == total // 2).all()
+    assert (hit < r.topo.num_links).all()  # padded tail never scheduled
+    assert (fn == -1).all()
+
+    b = preset("ba_blast")
+    rb = realize(b, pad, lane=0)
+    flb, fnb = failure_schedules(b, rb, pad, total, lane=0)
+    assert (flb == -1).all()
+    killed = set(np.flatnonzero(fnb >= 0).tolist())
+    assert killed, "blast killed nobody"
+    protected = set(int(x) for x in rb.servers) | set(
+        int(x) for x in np.asarray(rb.jobs.src)[np.asarray(rb.jobs.mask)])
+    assert not (killed & protected), "blast hit a protected node"
+
+
+def test_mobility_step_keeps_pad_and_maps_links():
+    s = preset("poisson_mobility")
+    pad = _shared_pad([s])
+    r = realize(s, pad, lane=0)
+    new_r, link_map = mobility_step(s, r, pad)
+    assert np.asarray(new_r.inst.link_rates).shape \
+        == np.asarray(r.inst.link_rates).shape  # same pad, same programs
+    assert not np.array_equal(new_r.pos, r.pos)
+    link_map = np.asarray(link_map)
+    surviving = link_map[link_map >= 0]
+    assert (surviving < r.topo.num_links).all()
+    np.testing.assert_array_equal(new_r.proc_bws, r.proc_bws)  # compute stays
+
+
+# ---------------------------------------------------------------------------
+# analytic vs sim at low rho — every family, one compiled program
+# ---------------------------------------------------------------------------
+
+
+def test_low_rho_analytic_vs_sim_agreement_every_family():
+    """One lane per topology family through the SAME compiled baseline
+    fleet at bottleneck rho ~0.35: per-channel empirical sojourn agrees
+    with the analytic 1/(mu - lambda) within 35% traffic-weighted per
+    lane (the committed scenario_matrix.json runs longer horizons), and
+    packet conservation is exact on every family — including the
+    heterogeneous-mu lanes."""
+    specs = [preset(FAMILY_REPS[f]) for f in sorted(FAMILY_REPS)]
+    pad = _shared_pad(specs)
+    bp = jax.jit(baseline_policy)
+    reals, outs, paramss = [], [], []
+    for i, s in enumerate(specs):
+        r = realize(s, pad, lane=0)
+        jobs, out = scale_to_util(r.inst, r.jobs, jax.random.PRNGKey(i),
+                                  0.35, policy_fn=bp)
+        r = dataclasses.replace(r, jobs=jobs)
+        reals.append(r)
+        outs.append(out)
+        paramss.append(build_sim_params(r.inst, r.jobs, margin=6.0))
+    spec_sim = spec_for(reals[0].inst, reals[0].jobs, cap=64)
+    sim = FleetSim(spec_sim, make_policy("baseline"), rounds=2,
+                   slots_per_round=1600)
+    keys = jax.random.split(jax.random.PRNGKey(17), len(specs))
+    run = sim.run(stack_instances([r.inst for r in reals]),
+                  stack_instances([r.jobs for r in reals]),
+                  stack_instances(paramss), keys,
+                  init_rates=jnp.stack([r.jobs.rate for r in reals]))
+    compared = 0
+    for lane, s in enumerate(specs):
+        st = jax.tree_util.tree_map(lambda x: np.asarray(x)[lane], run.state)
+        gen = int(st.generated.sum())
+        gap = gen - int(st.delivered.sum()) - int(st.dropped.sum()) \
+            - int(st.count[:-1].sum())
+        assert gap == 0, f"{s.family}: conservation gap {gap}"
+        assert gen > 0 and int(st.delivered.sum()) > 0, s.family
+        dt = float(np.asarray(paramss[lane].dt))
+        emp_l, _ = empirical_queue_delays(st, spec_sim, dt, min_served=40)
+        ana_l = analytic_link_delay(reals[lane].inst, outs[lane])
+        lam = np.asarray(outs[lane].delays.link_lambda, np.float64)
+        ok = np.isfinite(emp_l) & np.isfinite(ana_l) & (lam > 0)
+        assert ok.any(), f"{s.family}: no comparable links at this horizon"
+        rel = np.abs(emp_l[ok] - ana_l[ok]) / ana_l[ok]
+        w = lam[ok] / lam[ok].sum()
+        assert float((rel * w).sum()) < 0.35, s.family
+        compared += int(ok.sum())
+    assert compared >= 16
+
+
+# ---------------------------------------------------------------------------
+# shift injectors + drift campaign
+# ---------------------------------------------------------------------------
+
+
+def test_shift_tick_semantics():
+    a, b = preset("ba_poisson"), preset("grp_flash")
+    sched = shift(a, b, 4)
+    assert [sched.spec_at(t).name for t in (0, 3, 4, 5)] \
+        == ["ba_poisson", "ba_poisson", "grp_flash", "grp_flash"]
+    with pytest.raises(ValueError, match="at_tick"):
+        shift(a, b, 0)
+    events = sched.outcome_events(8, seed=1)
+    assert len(events) == 8
+    assert [e["shift_side"] for e in events] == ["from"] * 4 + ["to"] * 4
+    assert all({"tau", "is_local", "job_rate"} <= set(e) for e in events)
+    # deterministic per (schedule, ticks, seed)
+    assert events == sched.outcome_events(8, seed=1)
+    assert events != sched.outcome_events(8, seed=2)
+
+
+def test_shift_campaign_detects_after_the_switch_only():
+    row = shift_campaign(shift(preset("ba_poisson"), preset("grp_flash"), 32),
+                         96)
+    assert row["warmup_ok"] and row["detected"]
+    assert not row["false_positive"]
+    assert row["tripped_at"] >= 32 and row["detection_delay"] >= 0
+    assert row["trips"], "no trip records from the detectors"
+    # at_tick inside the warmup window voids the measurement, reported
+    # honestly rather than raised
+    short = shift_campaign(shift(preset("ba_poisson"), preset("grp_flash"),
+                                 8), 48)
+    assert not short["warmup_ok"]
+
+
+def test_campaign_report_bookkeeping_is_consistent():
+    """The report's fields cannot contradict each other, whatever the
+    detectors do: detected <=> (tripped_at >= at_tick), false_positive <=>
+    (tripped_at < at_tick), and the two are mutually exclusive — checked
+    on a stationary from==to schedule where any trip is detector noise."""
+    a = preset("ba_poisson")
+    row = shift_campaign(shift(a, a, 48), 96)
+    assert not (row["detected"] and row["false_positive"])
+    if row["tripped_at"] is None:
+        assert not row["detected"] and not row["false_positive"]
+    elif row["tripped_at"] >= row["at_tick"]:
+        assert row["detected"] and row["detection_delay"] \
+            == row["tripped_at"] - row["at_tick"]
+    else:
+        assert row["false_positive"] and row["detection_delay"] is None
