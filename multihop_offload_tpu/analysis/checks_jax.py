@@ -29,6 +29,12 @@ JX011 topology drawing  raw `networkx` graph constructors outside
                         (adj, pos) dtype normalization that
                         graphs.generators owns (the scenario matrix's
                         realizations must be reproducible per seed)
+JX012 use-after-donate  reading a buffer after passing it at a donated
+                        position of a `jax.jit(..., donate_argnums=...)`
+                        program — the donated pages may already back the
+                        program's outputs, so the read observes garbage
+                        on TPU (and nothing on CPU, where donation is a
+                        no-op and the bug ships silently)
 
 JX001 runs a small intraprocedural taint pass over each jit-reachable
 function (see `reachability`): values produced by `jax.*` calls are
@@ -47,7 +53,7 @@ escape it.
 from __future__ import annotations
 
 import ast
-from typing import Iterator, List, Set
+from typing import Iterator, List, Optional, Set
 
 from multihop_offload_tpu.analysis.modinfo import ModuleCtx
 from multihop_offload_tpu.analysis.rules import Finding, rule
@@ -743,3 +749,129 @@ def check_jx011(mod: ModuleCtx) -> Iterator[Finding]:
                      "with '# topo-ok(<why>)'"),
             snippet=_snippet(mod, node),
         )
+
+
+# ---------------------------------------------------------------------------
+# JX012 — use-after-donate
+# ---------------------------------------------------------------------------
+
+
+def _jx012_donated_positions(call: ast.Call) -> Optional[Set[int]]:
+    """Donated argument positions from a LITERAL `donate_argnums=` keyword;
+    None when absent or dynamic — non-literal donation vectors are skipped
+    (this is a tripwire for the common spelling, not alias analysis)."""
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return {int(v.value)}
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out: Set[int] = set()
+            for elt in v.elts:
+                if not (isinstance(elt, ast.Constant)
+                        and isinstance(elt.value, int)):
+                    return None
+                out.add(int(elt.value))
+            return out or None
+        return None
+    return None
+
+
+def _jx012_units(body):
+    """Statements in source order, each paired with the expression nodes
+    that execute AT that statement (compound statements contribute their
+    header only; their blocks are descended into as later units)."""
+    for stmt in body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue  # nested defs get their own linear scan
+        if isinstance(stmt, (ast.If, ast.While)):
+            yield [stmt.test]
+            yield from _jx012_units(stmt.body)
+            yield from _jx012_units(stmt.orelse)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            yield [stmt.iter, stmt.target]
+            yield from _jx012_units(stmt.body)
+            yield from _jx012_units(stmt.orelse)
+        elif isinstance(stmt, ast.Try):
+            yield from _jx012_units(stmt.body)
+            for h in stmt.handlers:
+                yield from _jx012_units(h.body)
+            yield from _jx012_units(stmt.orelse)
+            yield from _jx012_units(stmt.finalbody)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            yield [i.context_expr for i in stmt.items]
+            yield from _jx012_units(stmt.body)
+        else:
+            yield [stmt]
+
+
+@rule(
+    id="JX012", severity="error",
+    scope="package",
+    waiver="# donate-ok(",
+    doc=("use-after-donate: a buffer read after being passed at a donated "
+         "position of a `jax.jit(..., donate_argnums=...)` program — the "
+         "donated buffer's pages may already back the program's outputs, so "
+         "the read observes garbage on TPU and works by luck on CPU (where "
+         "donation is a no-op and the bug ships silently)"),
+)
+def check_jx012(mod: ModuleCtx) -> Iterator[Finding]:
+    # pass 1: names bound directly to a donating jax.jit(...) call —
+    # module-level or local, one shared namespace (tripwire granularity)
+    donating: dict = {}
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)):
+            continue
+        canon = (mod.canonical(node.value.func)
+                 if isinstance(node.value.func, (ast.Name, ast.Attribute))
+                 else None)
+        if canon != "jax.jit":
+            continue
+        pos = _jx012_donated_positions(node.value)
+        if not pos:
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                donating[tgt.id] = pos
+    if not donating:
+        return
+    # pass 2: per function, a linear statement scan — after a call to a
+    # donating program, a later load of a name it consumed is a finding;
+    # rebinding (or deleting) the name clears it.  Loop back-edges are not
+    # modeled: a donation at the bottom of a loop body does not poison the
+    # next iteration's reads (tripwire, not dataflow).
+    for qn, fi in mod.functions.items():
+        if isinstance(fi.node, ast.Lambda):
+            continue
+        consumed: dict = {}  # name -> (callee, donation line)
+        for exprs in _jx012_units(fi.node.body):
+            nodes = [n for e in exprs for n in ast.walk(e)]
+            for n in nodes:  # reads of already-donated buffers
+                if (isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+                        and n.id in consumed):
+                    callee, cline = consumed.pop(n.id)
+                    yield Finding(
+                        rule="JX012", path=mod.path, line=n.lineno,
+                        message=(
+                            f"'{n.id}' is read after being donated to "
+                            f"{callee}() on line {cline} — a donated "
+                            "buffer is invalid once the call is issued "
+                            "(its pages may back the outputs); copy "
+                            "before donating, reorder the read, or waive "
+                            "with '# donate-ok(<why>)'"),
+                        snippet=_snippet(mod, n),
+                    )
+            for n in nodes:  # new donations issued by this statement
+                if (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+                        and n.func.id in donating):
+                    for i in donating[n.func.id]:
+                        if i < len(n.args) and isinstance(n.args[i], ast.Name):
+                            consumed[n.args[i].id] = (n.func.id, n.lineno)
+            for n in nodes:  # rebinds clear the donation
+                if (isinstance(n, ast.Name)
+                        and isinstance(n.ctx, (ast.Store, ast.Del))
+                        and n.id in consumed):
+                    del consumed[n.id]

@@ -85,8 +85,8 @@ _REGISTRY: Dict[str, Rule] = {}
 GROUPS = {
     # the repo-specific rules lint.sh runs on both branches
     "repo": ("JX001", "JX002", "JX003", "JX004", "JX005", "JX006", "JX007",
-             "JX008", "JX009", "JX010", "JX011", "MP001", "SL001", "OB001",
-             "OB002", "OB003"),
+             "JX008", "JX009", "JX010", "JX011", "JX012", "MP001", "SL001",
+             "OB001", "OB002", "OB003"),
     # the ruff-approximation rules (E9/F401/F811) the fallback branch runs
     # over tests/ scripts/ bench.py as well as the package
     "pyflakes": ("E999", "F401", "F811"),

@@ -556,14 +556,16 @@ class ChaosSmoke:
             self.service.attach_health(recorder=recorder)
 
             ex = self.service.executor
-            orig_run = ex.run
+            # stall at `dispatch` — the device-work entry the service's
+            # two-phase tick issues (run() routes through it too)
+            orig_dispatch = ex.dispatch
             stall = {"s": 0.0}
 
-            def stalling_run(*a, **kw):
+            def stalling_dispatch(*a, **kw):
                 self.t["now"] += stall["s"]
-                return orig_run(*a, **kw)
+                return orig_dispatch(*a, **kw)
 
-            ex.run = stalling_run
+            ex.dispatch = stalling_dispatch
             try:
                 stall["s"] = 1.0      # slow: 1.0 > 0.5, under 10x
                 slow_resp = self._serve_ids(cfg, id_offset=70_000, count=4)
@@ -574,7 +576,7 @@ class ChaosSmoke:
                 self.t["now"] += 31.0  # recovery window expires
                 back_resp = self._serve_ids(cfg, id_offset=70_300, count=4)
             finally:
-                ex.run = orig_run
+                ex.dispatch = orig_dispatch
                 self.service.attach_watchdog(None)
                 self.service.attach_health()
             wd_events = [e for e in obs_events.read_events(cfg.obs_log)

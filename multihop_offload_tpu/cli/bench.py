@@ -14,7 +14,7 @@ every leg runs in the same process against the same device, so programs
 (and the Pallas kernels' obs/prof registrations) are shared across legs
 instead of being re-paid per subprocess as the per-axis A/B scripts do.
 
-Gate record (`benchmarks/bench_matrix.json`, key `gates`) — twelve keys,
+Gate record (`benchmarks/bench_matrix.json`, key `gates`) — sixteen keys,
 always all present (a partial record never flips defaults, see below):
 
   sourced from committed per-axis A/B artifacts (CPU-measurable evidence):
@@ -36,6 +36,16 @@ always all present (a partial record never flips defaults, see below):
     serve_scaling      folded from benchmarks/serving.json
                        sharded.linear_scaling.on_chip (populated by
                        scripts/serve_loadgen.py --mesh on a chip session)
+  measured by the `ragged_serve` leg (two services over the SAME bursty
+  low-occupancy MMPP schedule — dense full-width vs the occupancy ladder
+  with overlapped, donated ticks):
+    ragged_parity      ladder decisions bit-identical to dense full width
+                       (CPU-valid: decision parity is platform-independent)
+    ragged_cost        cost-model flops+bytes per dispatch >= 2.0x lower on
+                       the <=25%-occupancy rung vs full width (CPU-valid:
+                       the XLA cost-analysis ratio is layout-faithful)
+    ragged_perf_tpu    ragged+overlap tick throughput vs dense (chip-only)
+    ragged_tail_tpu    p99 time-in-system no worse than dense (chip-only)
 
 Defaults flip: `flip_defaults(gates)` is pure.  The shipped `--precision` /
 `--layout` defaults (multihop_offload_tpu/_defaults.json, read by
@@ -81,6 +91,7 @@ GATE_KEYS = (
     "fp_rung_384", "fp_rung_512",
     "chebconv_perf", "coo_apsp_perf",
     "serve_scaling",
+    "ragged_parity", "ragged_cost", "ragged_perf_tpu", "ragged_tail_tpu",
 )
 # the flip groups: shipped defaults move ONLY on these (kernel-impl gates
 # have their own auto crossovers and don't gate the precision/layout knobs)
@@ -334,6 +345,160 @@ def _run_leg(bench, name: str, knobs, reps: int) -> dict:
     }
 
 
+def _run_ragged_leg(smoke: bool):
+    """The ragged serving leg: dense full-width vs the occupancy ladder
+    (+ overlapped ticks, donated buffers) over the SAME bursty
+    low-occupancy MMPP arrival schedule.
+
+    Returns `(leg_record, measures)`: the leg record lands in the
+    campaign's `legs` (ticks-per-second as its step rate), the measures
+    feed the four `ragged_*` gates — parity and the cost-model reduction
+    are CPU-valid facts, the throughput/tail ratios are measured here but
+    judged on TPU only (`_chip_gate`)."""
+    import time
+
+    import numpy as np
+
+    from multihop_offload_tpu.cli.serve import build_service
+    from multihop_offload_tpu.loadgen.arrivals import (
+        TrafficModel,
+        arrival_times,
+    )
+    from multihop_offload_tpu.obs import prof as obs_prof
+    from multihop_offload_tpu.serve.workload import case_pool, request_stream
+
+    slots = 8
+    n_buckets = 2
+    tick_s = 1.0
+    duration_s = 24.0 if smoke else 64.0
+    # MMPP(2) bursty traffic: the slow phase offers ~2 req/s across the two
+    # buckets (~12.5% per-bucket occupancy at 1 Hz ticks); the fast phase
+    # bursts toward 8 req/s (~50%) — exactly the cold-with-flashes profile
+    # the ladder exists for
+    model = TrafficModel(base_rate=2.0, mmpp_burst_factor=4.0,
+                         mmpp_dwell_slow_s=6.0, mmpp_dwell_fast_s=1.5)
+    arrivals = np.asarray(arrival_times(model, duration_s, seed=13))
+    n_ticks = int(duration_s / tick_s)
+    counts = np.bincount(
+        np.minimum((arrivals / tick_s).astype(int), n_ticks - 1),
+        minlength=n_ticks,
+    ).tolist()
+    n_req = int(sum(counts))
+    # offered occupancy per bucket-dispatch (requests round-robin across
+    # the buckets): the regime the cost gate's criterion names
+    occupancy = n_req / (n_ticks * n_buckets * slots)
+
+    def _drive(ragged: bool):
+        cfg = Config(seed=7, dtype="float32", serve_slots=slots,
+                     serve_queue_cap=4 * slots, serve_deadline_s=1e9,
+                     serve_buckets=n_buckets,
+                     model_root="/nonexistent-model-root",
+                     serve_ragged=ragged, serve_overlap=ragged)
+        pool = case_pool([10, 16], per_size=1, seed=7)
+        service, pool = build_service(cfg, pool=pool)
+        reqs = iter(request_stream(pool, n_req, seed=11))
+        responses = []
+        t0 = time.perf_counter()
+        for c in counts:
+            for _ in range(int(c)):
+                if not service.submit(next(reqs)):
+                    raise RuntimeError("ragged leg traffic must all admit")
+            responses.extend(service.tick())
+        responses.extend(service.drain())
+        dt = time.perf_counter() - t0
+        return service, responses, dt
+
+    svc_dense, resp_dense, dt_dense = _drive(ragged=False)
+    svc_ragged, resp_ragged, dt_ragged = _drive(ragged=True)
+
+    # conservation + parity: every request answered exactly once in both
+    # modes, integer decisions (dst / is_local) bit-identical per request
+    by_dense = {r.request_id: r for r in resp_dense}
+    exact = close = 0
+    for r in resp_ragged:
+        d = by_dense[r.request_id]
+        exact += int((r.dst == d.dst).all()
+                     and (r.is_local == d.is_local).all())
+        close += int(np.allclose(r.delay_est, d.delay_est,
+                                 rtol=1e-5, atol=1e-6))
+    parity = (exact / n_req
+              if len(resp_ragged) == n_req and len(resp_dense) == n_req
+              else 0.0)
+
+    # cost-model reduction: the widest ladder rung at <=25% occupancy vs
+    # the full-width program, per bucket, from the prof layer's AOT
+    # cost/memory facts (flops and bytes both must clear the gate)
+    prof = obs_prof.prof_registry()
+    cost_detail = {}
+    ratios = []
+    for b in range(n_buckets):
+        widths = [w for (bb, w) in svc_ragged.executor._rungs
+                  if bb == b and w <= slots // 4]
+        if not widths:
+            continue
+        w_gate = max(widths)
+        full = prof.get(f"serve/bucket{b}/gnn")
+        rung = prof.get(f"serve/bucket{b}/gnn/w{w_gate}")
+        if full is None or rung is None:
+            continue
+        fl = (full.flops / rung.flops
+              if full.flops and rung.flops else None)
+        full_b = full.bytes_accessed or full.argument_bytes
+        rung_b = rung.bytes_accessed or rung.argument_bytes
+        by = full_b / rung_b if full_b and rung_b else None
+        cost_detail[f"bucket{b}"] = {
+            "rung_width": w_gate, "full_width": slots,
+            "flops_ratio": round(fl, 2) if fl else None,
+            "bytes_ratio": round(by, 2) if by else None,
+        }
+        if fl and by:
+            ratios.append(min(fl, by))
+    cost_ratio = round(min(ratios), 2) if ratios else None
+
+    def _p99(resps):
+        lat = sorted(float(r.latency_s) for r in resps)
+        if not lat:
+            return None
+        return lat[min(len(lat) - 1, max(0, int(round(0.99 * (len(lat) - 1)))))]
+
+    p99_dense, p99_ragged = _p99(resp_dense), _p99(resp_ragged)
+    rps_ratio = (round((len(resp_ragged) / dt_ragged)
+                       / (len(resp_dense) / dt_dense), 4)
+                 if dt_ragged > 0 and dt_dense > 0 and resp_dense else None)
+    tail_ratio = (round(p99_dense / p99_ragged, 4)
+                  if p99_dense and p99_ragged else None)
+
+    summary = svc_ragged.stats.summary(wall_s=dt_ragged)
+    leg = {
+        "knobs": {"serve_slots": slots, "serve_buckets": n_buckets,
+                  "serve_ragged": True, "serve_overlap": True,
+                  "traffic": "mmpp burst_factor=4.0 base_rate=2.0"},
+        "batch": slots, "reps": svc_ragged.stats.ticks,
+        "paths": {"apsp": "xla", "fp": "xla", "cheb": None,
+                  "coo_apsp": None},
+        "requests": n_req, "ticks": int(svc_ragged.stats.ticks),
+        "offered_occupancy": round(occupancy, 4),
+        "steps_per_sec": round(svc_ragged.stats.ticks / dt_ragged, 2),
+        "dense_steps_per_sec": round(svc_dense.stats.ticks / dt_dense, 2),
+        "dt_s": round(dt_ragged, 4), "dense_dt_s": round(dt_dense, 4),
+        "p99_s": round(p99_ragged, 6) if p99_ragged else None,
+        "dense_p99_s": round(p99_dense, 6) if p99_dense else None,
+        "decision_agreement": round(parity, 4),
+        "delay_est_close": round(close / max(n_req, 1), 4),
+        "ladder_transitions": len(svc_ragged.ladder.transitions),
+        "final_widths": [svc_ragged.ladder.width_of(b)
+                         for b in range(n_buckets)],
+        "mean_width": {b: s.get("mean_width")
+                       for b, s in (summary.get("per_bucket") or {}).items()},
+        "slots_saved": {b: s.get("slots_saved")
+                        for b, s in (summary.get("per_bucket") or {}).items()},
+        "cost_model": cost_detail,
+    }
+    measures = {"parity": parity, "cost_ratio": cost_ratio,
+                "rps_ratio": rps_ratio, "tail_ratio": tail_ratio}
+    return leg, measures
+
+
 def _ratio(legs, num: str, den: str, field: str = "steps_per_sec"):
     a, b = legs.get(num), legs.get(den)
     if a and b and a.get(field) and b.get(field):
@@ -341,9 +506,10 @@ def _ratio(legs, num: str, den: str, field: str = "steps_per_sec"):
     return None
 
 
-def _build_gates(legs, on_tpu: bool):
-    """The twelve-key gate dict: committed-artifact sources + chip gates
-    measured from this campaign's legs + the serve-scaling hook."""
+def _build_gates(legs, on_tpu: bool, ragged=None):
+    """The sixteen-key gate dict: committed-artifact sources + chip gates
+    measured from this campaign's legs + the serve-scaling hook + the
+    ragged serving leg's parity/cost facts and chip ratios."""
     pab = _read_json(_bench_path("precision_ab.json")) or {}
     lab = _read_json(_bench_path("layout_ab.json")) or {}
     srv = _read_json(_bench_path("serving.json")) or {}
@@ -364,6 +530,7 @@ def _build_gates(legs, on_tpu: bool):
 
     # the closed-loop record moved under `legacy` when the open-loop
     # headline landed; fall back to top-level for pre-open-loop records
+    rg = ragged or {}
     srv_legacy = srv.get("legacy") or srv
     mesh = ((srv_legacy.get("sharded") or {}).get("linear_scaling") or {})
     on_chip = mesh.get("on_chip") if isinstance(mesh, dict) else None
@@ -441,6 +608,34 @@ def _build_gates(legs, on_tpu: bool):
             coo, 1.1, _proxy("coo/xla step-rate ratio (xla-fallback)", coo),
             on_tpu),
         "serve_scaling": serve_gate,
+        "ragged_parity": {
+            "criterion": "ragged ladder decisions bit-identical to dense "
+                         "full width (dst/is_local exact over the full "
+                         "bursty low-occupancy run)",
+            "measured": rg.get("parity"),
+            "pass": (None if rg.get("parity") is None
+                     else rg.get("parity") == 1.0),
+            "source": "measured in-process (ragged_serve leg; decision "
+                      "parity is platform-independent)"},
+        "ragged_cost": {
+            "criterion": "cost-model flops AND bytes per dispatch >= 2.0x "
+                         "lower on the <=25%-occupancy ladder rung vs the "
+                         "full-width program",
+            "measured": rg.get("cost_ratio"),
+            "pass": (None if rg.get("cost_ratio") is None
+                     else rg.get("cost_ratio") >= 2.0),
+            "source": "measured in-process (ragged_serve leg; the XLA "
+                      "cost-analysis ratio is layout-faithful on CPU)"},
+        "ragged_perf_tpu": _chip_gate(
+            "tpu ragged+overlap tick throughput >= 1.2x dense full width "
+            "on the bursty low-occupancy schedule",
+            rg.get("rps_ratio"), 1.2,
+            f"CPU-proxy throughput ratio {rg.get('rps_ratio')}", on_tpu),
+        "ragged_tail_tpu": _chip_gate(
+            "tpu ragged serve p99 time-in-system no worse than dense "
+            "(dense/ragged p99 ratio >= 1.0)",
+            rg.get("tail_ratio"), 1.0,
+            f"CPU-proxy p99 ratio {rg.get('tail_ratio')}", on_tpu),
     }
 
 
@@ -486,9 +681,13 @@ def run_matrix(cfg: Config, smoke: bool, out_path: str) -> dict:
         if first:
             jaxhooks.mark_steady()  # timed loops must never retrace
             first = False
+    print("[matrix] leg ragged_serve ...", file=sys.stderr)
+    with jaxhooks.expected_rebuild():
+        ragged_leg, ragged_meas = _run_ragged_leg(smoke)
+    legs["ragged_serve"] = ragged_leg
     wall_s = time.perf_counter() - t0
 
-    gates = _build_gates(legs, on_tpu)
+    gates = _build_gates(legs, on_tpu, ragged_meas)
 
     # never clobber committed TPU evidence with a CPU re-run
     old = _read_json(out_path) or {}
@@ -571,6 +770,11 @@ def run_matrix(cfg: Config, smoke: bool, out_path: str) -> dict:
             "no_unexpected_retraces": record["unexpected_retraces"] == 0,
             "no_warning_events": not any(e.get("event") == "warning"
                                          for e in events),
+            # the ragged leg's CPU-valid facts are asserted, not nulled:
+            # decision parity and the cost-model reduction must hold on
+            # every platform the drill runs on
+            "ragged_parity_exact": gates["ragged_parity"].get("pass") is True,
+            "ragged_cost_2x": gates["ragged_cost"].get("pass") is True,
         }
         record["checks"] = checks
         record["ok"] = all(checks.values())

@@ -105,6 +105,10 @@ def build_service(cfg: Config, pool=None, clock=None, devices=None,
         trace=getattr(cfg, "obs_trace", True),
         mesh_devices=devices if devices is not None else resolve_serve_devices(cfg),
         replan_every=max(1, int(getattr(cfg, "serve_replan_ticks", 16))),
+        ragged=getattr(cfg, "serve_ragged", False),
+        overlap=getattr(cfg, "serve_overlap", False),
+        ladder_alpha=getattr(cfg, "serve_ladder_alpha", 0.5),
+        ladder_hysteresis=getattr(cfg, "serve_ladder_hysteresis", 0.25),
         **({"clock": clock} if clock is not None else {}),
     )
     if cfg.health_watchdog_s > 0:

@@ -205,6 +205,17 @@ class Config:
     #                                serving fleet (overrides serve_mesh)
     serve_replan_ticks: int = 16   # placement re-plan cadence (ticks); plans
     #                                change BETWEEN ticks, never mid-program
+    serve_ragged: bool = False     # occupancy-aware serving: cold buckets tick
+    #                                at a narrower compiled width chosen by the
+    #                                EWMA occupancy ladder (single-device
+    #                                executor; decisions stay bit-identical)
+    serve_overlap: bool = False    # cross-tick double buffering: defer each
+    #                                tick's device sync to the next tick so
+    #                                host packing overlaps device compute
+    serve_ladder_alpha: float = 0.5       # EWMA weight of the occupancy ladder
+    serve_ladder_hysteresis: float = 0.25  # narrow a rung only when
+    #                                EWMA*(1+h) fits it — jitter never
+    #                                thrashes a compile
     model_root: str = "model"      # parent dir of checkpoint directories
     tb_logdir: str = ""            # TensorBoard scalars ("" = disabled); the
     #                                working version of the reference's

@@ -263,6 +263,31 @@ def render_report(path: str) -> str:
                 tag = f"{name}{'' if lab == '(total)' else lab}"
                 val = int(v) if float(v) == int(v) else round(v, 4)
                 lines.append(f"  {tag:<42} {val}")
+        # serve-side histograms (latency, per-bucket occupancy): the
+        # counter view above drops dict-valued series, so render them as
+        # count/sum/mean rows — mean occupancy per bucket is the signal
+        # the width ladder and the `ragged` bench leg act on
+        hist_rows = []
+        for name in sorted(serve_counters):
+            m = metrics.get(name) or {}
+            if m.get("kind") != "histogram":
+                continue
+            for lab, s in sorted((m.get("series") or {}).items()):
+                if not isinstance(s, dict):
+                    continue
+                cnt = int(s.get("count") or 0)
+                hist_rows.append([
+                    f"{name}{'' if not lab else lab}", cnt,
+                    _fmt_opt(s.get("sum"), "{:.4g}"),
+                    _fmt_opt((s.get("sum") or 0.0) / cnt if cnt else None,
+                             "{:.4g}"),
+                    _fmt_opt(s.get("min"), "{:.4g}"),
+                    _fmt_opt(s.get("max"), "{:.4g}"),
+                ])
+        if hist_rows:
+            lines += ["  " + ln for ln in _table(
+                ["histogram", "count", "sum", "mean", "min", "max"],
+                hist_rows)]
         last_tick = run["last"].get("tick")
         if last_tick and "queue_depth" in last_tick:
             lines.append(f"  {'queue_depth (last tick)':<42} "

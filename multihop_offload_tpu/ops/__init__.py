@@ -10,7 +10,10 @@ from multihop_offload_tpu.ops.fixed_point import fixed_point_pallas  # noqa: F40
 from multihop_offload_tpu.ops.chebconv import (  # noqa: F401
     chebconv_path,
     chebconv_propagate_pallas,
+    chebconv_propagate_ragged,
+    chebconv_ragged_path,
     make_fused_propagate,
+    make_fused_propagate_ragged,
     resolve_chebconv,
 )
 from multihop_offload_tpu.ops.sparse import (  # noqa: F401

@@ -37,7 +37,9 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from multihop_offload_tpu.ops.minplus import tpu_backend
 from multihop_offload_tpu.precision import island_dtype
@@ -204,6 +206,196 @@ def make_fused_propagate(accum_dtype=None, *, interpret: bool = False,
             edge_block)
 
     return propagate
+
+
+# ---- ragged edge count: occupancy-aware serving ---------------------------
+#
+# A serving bucket at low occupancy packs far fewer live edges than its
+# static nnz pad; the dense tile above still walks every padded block.  The
+# ragged variant takes the LIVE edge count as a scalar-prefetch argument
+# (available before the kernel body runs — `pltpu.PrefetchScalarGridSpec`),
+# and skips every edge block past it.  The contract is the sparse layout's
+# own padding convention: edges at index >= nnz_live MUST be inert
+# (row=0, col=0, val=0), so a skipped block contributes exactly the +0.0 a
+# full walk would have — at any live count the ragged kernel's output is
+# BIT-IDENTICAL to itself walking the whole capacity (tests pin this).
+# Against the dense tile / XLA reference it carries the fused tile's
+# existing bar: values at the layouts scaled tolerance, decisions
+# bit-parity gated.  Off-TPU (non-interpret) the same honesty contract as
+# the dense tile holds: delegate to the masked XLA reference, which IS
+# bitwise the reference.
+
+
+def _chebconv_ragged_kernel(live_ref, rows_ref, cols_ref, vals_ref, diag_ref,
+                            x_ref, o_ref):
+    x = x_ref[...]                       # (N, F) acc dtype
+    n = x.shape[0]
+    eb = rows_ref.shape[1]
+
+    @pl.when(pl.program_id(0) == 0)
+    def _seed_diag():
+        o_ref[...] = diag_ref[...] * x   # (N, 1) * (N, F)
+
+    @pl.when(pl.program_id(0) * eb < live_ref[0])
+    def _edge_block():
+        # identical math to the dense kernel; a block whose first edge is
+        # past the live count is all-inert and skipped outright
+        rows = rows_ref[...]             # (1, Eb) int32
+        cols = cols_ref[...]
+        vals = vals_ref[...]             # (1, Eb) acc dtype
+        node = jax.lax.broadcasted_iota(jnp.int32, (n, rows.shape[1]), 0)
+        gather = (node == cols).astype(x.dtype)
+        gathered = jax.lax.dot_general(
+            gather, x, (((0,), (0,)), ((), ())),
+            preferred_element_type=x.dtype)
+        scatter = jnp.where(node == rows, vals, 0).astype(x.dtype)
+        o_ref[...] += jax.lax.dot_general(
+            scatter, gathered, (((1,), (0,)), ((), ())),
+            preferred_element_type=x.dtype)
+
+
+def chebconv_ragged_cost_facts(n: int, nnz_live: int, nnz_cap: int,
+                               feat: int, dtype_bytes: int = 4,
+                               edge_block: int = _EDGE_BLOCK) -> dict:
+    """Analytic EXECUTED cost of one ragged call: only `ceil(live / Eb)`
+    edge blocks run their two matmuls, so flops/bytes scale with occupancy
+    instead of the static pad.  `nnz_cap` is the padded capacity the dense
+    tile would have walked — the CPU-proxy cost-reduction gate in the bench
+    matrix divides the dense facts by these."""
+    eb = min(edge_block, _pad_to(max(nnz_cap, 1), _LANE))
+    blocks = math.ceil(max(int(nnz_live), 1) / eb)
+    nnz_run = blocks * eb
+    flops = 4.0 * n * nnz_run * feat + 2.0 * n * feat
+    bytes_accessed = (
+        2 * nnz_run * 4
+        + nnz_run * dtype_bytes
+        + n * dtype_bytes
+        + 2 * n * feat * dtype_bytes
+    )
+    return {"flops": flops, "bytes_accessed": float(bytes_accessed),
+            "argument_bytes": float(bytes_accessed - n * feat * dtype_bytes)}
+
+
+def _register_ragged(n: int, nnz_cap: int, feat: int, dtype_bytes: int) -> None:
+    key = ("ragged", n, nnz_cap, feat, dtype_bytes)
+    if key in _REGISTERED:
+        return
+    _REGISTERED.add(key)
+    from multihop_offload_tpu.obs.prof import register_kernel
+
+    # registered at CAPACITY (the static shape jit sees); the per-call
+    # executed work is occupancy-dependent — chebconv_ragged_cost_facts is
+    # the analytic scaler consumers apply
+    register_kernel(
+        "ops/chebconv_ragged",
+        **chebconv_cost_facts(n, nnz_cap, feat, dtype_bytes),
+        labels={"kind": "pallas-ragged", "shape": f"n{n}_cap{nnz_cap}_f{feat}"})
+
+
+def _forward_ragged(rows, cols, vals, diag, x, nnz_live, acc_name, interpret,
+                    edge_block):
+    acc = jnp.dtype(acc_name)
+    if not interpret and not tpu_backend():
+        # honesty contract: off-TPU run the masked XLA reference — the inert
+        # tail (vals == 0 past nnz_live) makes it bit-identical to the skip
+        return _xla_propagate(rows, cols, vals, diag, x, acc)
+
+    n, f = x.shape
+    (e,) = rows.shape
+    n_pad = _pad_to(n, _SUBLANE)
+    f_pad = _pad_to(f, _LANE)
+    eb = min(edge_block, _pad_to(e, _LANE))
+    e_pad = _pad_to(e, eb)
+    _register_ragged(n_pad, e_pad, f_pad, acc.itemsize)
+
+    rows_p = jnp.zeros((1, e_pad), jnp.int32).at[0, :e].set(rows)
+    cols_p = jnp.zeros((1, e_pad), jnp.int32).at[0, :e].set(cols)
+    vals_p = jnp.zeros((1, e_pad), acc).at[0, :e].set(vals.astype(acc))
+    diag_p = jnp.zeros((n_pad, 1), acc).at[:n, 0].set(diag.astype(acc))
+    x_p = jnp.zeros((n_pad, f_pad), acc).at[:n, :f].set(x.astype(acc))
+    live = jnp.asarray(nnz_live, jnp.int32).reshape((1,))
+
+    out = pl.pallas_call(
+        _chebconv_ragged_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(e_pad // eb,),
+            in_specs=[
+                pl.BlockSpec((1, eb), lambda i, live: (0, i)),      # rows
+                pl.BlockSpec((1, eb), lambda i, live: (0, i)),      # cols
+                pl.BlockSpec((1, eb), lambda i, live: (0, i)),      # vals
+                pl.BlockSpec((n_pad, 1), lambda i, live: (0, 0)),   # diag
+                pl.BlockSpec((n_pad, f_pad), lambda i, live: (0, 0)),
+            ],
+            out_specs=pl.BlockSpec((n_pad, f_pad), lambda i, live: (0, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((n_pad, f_pad), acc),
+        interpret=interpret,
+    )(live, rows_p, cols_p, vals_p, diag_p, x_p)
+    return out[:n, :f].astype(x.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8))
+def chebconv_propagate_ragged(rows, cols, vals, diag, x, nnz_live,
+                              acc_name: str = "float32",
+                              interpret: bool = False,
+                              edge_block: int = _EDGE_BLOCK):
+    """Ragged-occupancy fused ChebConv propagate (custom_vjp primal).
+
+    Same arguments as `chebconv_propagate_pallas` plus `nnz_live` — the
+    LIVE edge count (int32 scalar, may be traced: one compiled program
+    serves every occupancy).  Edges past `nnz_live` must be inert padding
+    (row=0, col=0, val=0); given that, the output at any live count is
+    bit-identical to the same kernel walking the full capacity.  The
+    backward recomputes through `_xla_propagate` exactly like the dense
+    tile's."""
+    return _forward_ragged(rows, cols, vals, diag, x, nnz_live, acc_name,
+                           interpret, edge_block)
+
+
+def _cheb_ragged_fwd(rows, cols, vals, diag, x, nnz_live, acc_name, interpret,
+                     edge_block):
+    out = chebconv_propagate_ragged(rows, cols, vals, diag, x, nnz_live,
+                                    acc_name, interpret, edge_block)
+    return out, (rows, cols, vals, diag, x, nnz_live)
+
+
+def _cheb_ragged_bwd(acc_name, interpret, edge_block, res, g):
+    rows, cols, vals, diag, x, nnz_live = res
+    _, vjp = jax.vjp(
+        functools.partial(_xla_propagate, acc=jnp.dtype(acc_name)),
+        rows, cols, vals, diag, x)
+    # the live count is integer data, never differentiated: float0, exactly
+    # what jax.vjp hands back for the int rows/cols
+    zero_live = np.zeros(np.shape(nnz_live), jax.dtypes.float0)
+    return (*vjp(g), zero_live)
+
+
+chebconv_propagate_ragged.defvjp(_cheb_ragged_fwd, _cheb_ragged_bwd)
+
+
+def make_fused_propagate_ragged(accum_dtype=None, *, interpret: bool = False,
+                                edge_block: int = _EDGE_BLOCK):
+    """Ragged twin of `make_fused_propagate`: `propagate(support, x,
+    nnz_live)` skips edge blocks past the live count (serving buckets pass
+    their packed batch's real edge count; the static nnz pad stays the
+    compiled shape)."""
+
+    def propagate(support, x, nnz_live):
+        e = support.edges
+        acc = jnp.dtype(accum_dtype or island_dtype(x.dtype))
+        return chebconv_propagate_ragged(
+            e.rows, e.cols, e.vals, support.diag, x, nnz_live, acc.name,
+            interpret, edge_block)
+
+    return propagate
+
+
+def chebconv_ragged_path(interpret: bool = False) -> str:
+    """Which implementation `chebconv_propagate_ragged` actually runs:
+    'pallas' | 'xla-fallback' — the dense tile's honesty contract verbatim
+    (off-TPU the masked XLA reference serves, and callers must report it)."""
+    return chebconv_path(interpret)
 
 
 def chebconv_path(interpret: bool = False) -> str:

@@ -73,6 +73,95 @@ class ShapeBuckets:
         return None
 
 
+class OccupancyLadder:
+    """EWMA-occupancy width policy: cold buckets tick at narrower widths.
+
+    A bucket whose queue holds 3 requests against 64 slots still pays a
+    64-wide program without this — the batch axis is just another sparsity
+    axis (the ragged-kernel argument, applied to slots).  The ladder keeps a
+    per-bucket EWMA of live counts and picks a compiled width from a
+    power-of-two rung ladder:
+
+    * **widen immediately** to the smallest rung that fits this tick's
+      pending work — real requests are never clipped below what full slots
+      would take;
+    * **narrow one rung at a time**, and only when the EWMA (inflated by
+      `hysteresis`) clears the narrower rung — occupancy jitter around a
+      rung boundary therefore never thrashes a compile.
+
+    Every width is a separate compiled program (built once, inside
+    `expected_rebuild`), so the ladder trades a bounded number of builds —
+    at most `len(rungs)` per bucket, ever — for per-tick cost proportional
+    to occupancy."""
+
+    def __init__(self, n_buckets: int, slots: int, alpha: float = 0.5,
+                 hysteresis: float = 0.25):
+        if slots < 1 or n_buckets < 1:
+            raise ValueError("n_buckets and slots must be >= 1")
+        if not (0.0 < alpha <= 1.0):
+            raise ValueError("alpha must be in (0, 1]")
+        if hysteresis < 0.0:
+            raise ValueError("hysteresis must be >= 0")
+        self.slots = int(slots)
+        rungs = []
+        w = 1
+        while w < self.slots:
+            rungs.append(w)
+            w *= 2
+        rungs.append(self.slots)
+        #: ascending power-of-two widths, always ending at full `slots`
+        self.rungs: List[int] = rungs
+        self.alpha = float(alpha)
+        self.hysteresis = float(hysteresis)
+        # start at full width: a fresh service has no occupancy evidence,
+        # and the full-width program is the one warmup builds anyway
+        self._ewma = [float(self.slots)] * n_buckets
+        self._width = [self.slots] * n_buckets
+        #: rung transitions as (bucket, old, new) — telemetry + tests
+        self.transitions: List[Tuple[int, int, int]] = []
+
+    def width_of(self, bucket: int) -> int:
+        """The bucket's current rung (what the NEXT select starts from)."""
+        return self._width[bucket]
+
+    def ewma_of(self, bucket: int) -> float:
+        return self._ewma[bucket]
+
+    def rung_for(self, need: int) -> int:
+        """Smallest rung >= need (clamped to full width)."""
+        for w in self.rungs:
+            if w >= need:
+                return w
+        return self.slots
+
+    def observe(self, bucket: int, live: int) -> None:
+        """Fold one tick's live count into the bucket's EWMA."""
+        self._ewma[bucket] += self.alpha * (float(live) - self._ewma[bucket])
+
+    def select(self, bucket: int, pending: int) -> int:
+        """Width for this tick given `pending` queued requests.
+
+        Returns a rung >= min(pending, slots): the dispatch always takes
+        exactly as many requests as the full-width policy would."""
+        need = min(max(int(pending), 1), self.slots)
+        cur = self._width[bucket]
+        target = self.rung_for(need)
+        if target > cur:
+            # a burst outruns the EWMA: widen in one step, no hysteresis —
+            # correctness (don't strand queued work) beats compile thrift
+            self._width[bucket] = target
+            self.transitions.append((bucket, cur, target))
+            return target
+        idx = self.rungs.index(cur)
+        if idx > 0:
+            down = self.rungs[idx - 1]
+            if need <= down and self._ewma[bucket] * (1.0 + self.hysteresis) <= down:
+                self._width[bucket] = down
+                self.transitions.append((bucket, cur, down))
+                return down
+        return cur
+
+
 def pack_bucket(
     reqs: Sequence[OffloadRequest],
     pad: PadSpec,

@@ -17,15 +17,17 @@ requests.
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import time
-from typing import Optional
+from typing import Dict, Optional, Tuple
 
 import jax
 import numpy as np
 
 from multihop_offload_tpu.agent.policy import forward_env
 from multihop_offload_tpu.env.policies import baseline_policy
+from multihop_offload_tpu.obs import jaxhooks
 from multihop_offload_tpu.obs import prof as obs_prof
 from multihop_offload_tpu.obs import trace as obs_trace
 from multihop_offload_tpu.serve.bucketing import ShapeBuckets
@@ -91,6 +93,23 @@ def param_signature(tree):
              str(np.asarray(x).dtype)) for p, x in flat]
 
 
+@dataclasses.dataclass
+class DispatchHandle:
+    """One in-flight dispatch: device values enqueued but not yet fetched.
+
+    The dispatch/fetch split is what lets the service launch EVERY non-empty
+    bucket's program before paying any device sync, and (in overlap mode)
+    lets the host pack tick t+1 while tick t computes — `fetch` is the only
+    sync boundary."""
+
+    bucket: int
+    step: object
+    out: object
+    dev: object
+    t0: float
+    degraded: bool
+
+
 class BucketExecutor:
     """Compiled decision programs over a bucket ladder, plus weight state."""
 
@@ -104,6 +123,8 @@ class BucketExecutor:
         prob: bool = False,
         precision=None,
         layout=None,
+        slots: Optional[int] = None,
+        donate: bool = True,
     ):
         from multihop_offload_tpu.layouts import resolve_layout
         from multihop_offload_tpu.precision import resolve_precision
@@ -129,9 +150,19 @@ class BucketExecutor:
         # re-restore and re-reject the same poisoned checkpoint every tick.
         self.canary = None
         self._canary_rejected: set = set()
-        dm = self.devmetrics
+        # slot capacity of the full-width programs (None = unknown: width
+        # rungs disabled, every dispatch uses the full-width program)
+        self.slots = None if slots is None else int(slots)
+        # tick-buffer donation: pad instances/jobs/keys are dead after the
+        # dispatch consumes them, so the device may reuse their pages for
+        # the outputs.  CPU jit warns on donation, so the knob resolves off
+        # there — semantics are identical, only allocator pressure differs.
+        self._donate = bool(donate) and jax.default_backend() != "cpu"
         self._steps = {}
         self._closures = {}
+        # narrow-width rung programs, keyed (bucket, width), built lazily on
+        # the first tick the occupancy ladder selects that width
+        self._rungs: Dict[Tuple[int, int], tuple] = {}
         for b, pad in enumerate(buckets.pads):
             gnn_step, baseline_step = self._bucket_closures(
                 pad, apsp_impl, fp_impl, prob
@@ -140,28 +171,60 @@ class BucketExecutor:
             # decision math the sharded executor compiles too (bit-parity);
             # the accumulators wrap around them per execution path
             self._closures[b] = (gnn_step, baseline_step)
+            self._steps[b] = self._make_step_programs(b, gnn_step,
+                                                      baseline_step)
 
-            def gnn_dev(variables, binst, bjobs, keys, _g=gnn_step):
-                out = _g(variables, binst, bjobs, keys)
-                return out, observe_decisions(dm, out, bjobs.mask)
+    def _make_step_programs(self, bucket: int, gnn_step, baseline_step,
+                            width: Optional[int] = None):
+        """Jit + prof-wrap one (gnn, baseline) program pair.  The raw
+        closures are batch-width polymorphic (`jax.vmap` over the slot
+        axis), so the SAME closure compiles the full-width program and every
+        narrow ladder rung — each width is its own prof program
+        (`serve/bucket{b}/gnn/w{width}`) so per-rung cost is attributable.
 
-            def baseline_dev(binst, bjobs, keys, _b=baseline_step):
-                out = _b(binst, bjobs, keys)
-                return out, observe_decisions(dm, out, bjobs.mask)
+        Each program registers with the prof layer on its first dispatch
+        (AOT compile + cost/memory analysis); the compiled executable then
+        serves every later tick."""
+        dm = self.devmetrics
 
-            # each bucket program registers with the prof layer on its
-            # first dispatch (AOT compile + cost/memory analysis); the
-            # compiled executable then serves every later tick
-            self._steps[b] = (
-                obs_prof.wrap(
-                    f"serve/bucket{b}/gnn",
-                    jax.jit(gnn_dev),  # retrace-ok(one program per bucket, built once at construction)
-                ),
-                obs_prof.wrap(
-                    f"serve/bucket{b}/baseline",
-                    jax.jit(baseline_dev),  # retrace-ok(same: the loop IS the build)
-                ),
+        def gnn_dev(variables, binst, bjobs, keys, _g=gnn_step):
+            out = _g(variables, binst, bjobs, keys)
+            return out, observe_decisions(dm, out, bjobs.mask)
+
+        def baseline_dev(binst, bjobs, keys, _b=baseline_step):
+            out = _b(binst, bjobs, keys)
+            return out, observe_decisions(dm, out, bjobs.mask)
+
+        if self._donate:
+            # weights (arg 0 of gnn_dev) are NEVER donated: they persist
+            # across ticks; only the per-tick pack buffers are dead after
+            # the dispatch consumes them
+            gnn_jit = jax.jit(gnn_dev, donate_argnums=(1, 2, 3))  # retrace-ok(one program per (bucket, width), built once)
+            baseline_jit = jax.jit(baseline_dev, donate_argnums=(0, 1, 2))  # retrace-ok(same: built once per rung)
+        else:
+            gnn_jit = jax.jit(gnn_dev)  # retrace-ok(one program per (bucket, width), built once)
+            baseline_jit = jax.jit(baseline_dev)  # retrace-ok(same: built once per rung)
+        suffix = "" if width is None else f"/w{int(width)}"
+        return (
+            obs_prof.wrap(f"serve/bucket{bucket}/gnn{suffix}", gnn_jit),
+            obs_prof.wrap(f"serve/bucket{bucket}/baseline{suffix}",
+                          baseline_jit),
+        )
+
+    def _steps_for(self, bucket: int, width: Optional[int] = None):
+        """The (gnn, baseline) program pair for a bucket at a ladder width.
+        Full width (or unknown capacity) returns the construction-time
+        programs — identity-stable, so hot reload never touches a compiled
+        executable.  Narrow widths build (once) and reuse a rung program."""
+        if width is None or self.slots is None or int(width) == self.slots:
+            return self._steps[bucket]
+        key = (bucket, int(width))
+        if key not in self._rungs:
+            gnn_step, baseline_step = self._closures[bucket]
+            self._rungs[key] = self._make_step_programs(
+                bucket, gnn_step, baseline_step, width=int(width)
             )
+        return self._rungs[key]
 
     def _bucket_closures(self, pad, apsp_impl: str, fp_impl: str, prob: bool):
         """The raw (gnn_step, baseline_step) python closures for one bucket
@@ -201,17 +264,28 @@ class BucketExecutor:
 
         return gnn_step, baseline_step
 
-    def run(self, bucket: int, binst, bjobs, keys, degraded: bool = False,
-            request_ids=None):
-        """One fused dispatch; returns host numpy (dst, is_local, delay_est,
-        job_total), each (slots, pad.j), via one bulk device->host fetch.
-        `request_ids` (when the service traces) stamps the batch with a
-        ``dispatch`` hop — which program ran, on which weights."""
-        gnn, baseline = self._steps[bucket]
+    def dispatch(self, bucket: int, binst, bjobs, keys,
+                 degraded: bool = False, request_ids=None,
+                 width: Optional[int] = None) -> DispatchHandle:
+        """Enqueue one fused decision program and return WITHOUT syncing.
+        The returned handle carries the device values; `fetch` performs the
+        single bulk device->host sync.  `request_ids` (when the service
+        traces) stamps the batch with a ``dispatch`` hop — which program
+        ran, on which weights.  `width` selects a ladder rung program; the
+        pack buffers must already be that width."""
+        gnn, baseline = self._steps_for(bucket, width)
         step = baseline if degraded else gnn
         t0 = time.perf_counter()  # nondet-ok(device-time accounting is a measurement)
-        out, dev = (baseline(binst, bjobs, keys) if degraded
-                    else gnn(self.variables, binst, bjobs, keys))
+        if step.built:
+            out, dev = (step(binst, bjobs, keys) if degraded
+                        else step(self.variables, binst, bjobs, keys))
+        else:
+            # first dispatch at this (bucket, width): the build is expected
+            # — ladder transitions must not trip the zero-unexpected-retrace
+            # steady-state invariant
+            with jaxhooks.expected_rebuild():
+                out, dev = (step(binst, bjobs, keys) if degraded
+                            else step(self.variables, binst, bjobs, keys))
         self.dispatch_count += 1
         if request_ids:
             obs_trace.hop(
@@ -220,16 +294,31 @@ class BucketExecutor:
                 program="baseline" if degraded else "gnn",
                 step=self.loaded_step,
             )
-        host_out, host_dev = jax.device_get((out, dev))
+        return DispatchHandle(bucket=bucket, step=step, out=out, dev=dev,
+                              t0=t0, degraded=degraded)
+
+    def fetch(self, handle: DispatchHandle):
+        """Resolve one in-flight dispatch; returns host numpy (dst,
+        is_local, delay_est, job_total), each (width, pad.j), via one bulk
+        device->host fetch."""
+        host_out, host_dev = jax.device_get((handle.out, handle.dev))
         host = tuple(np.asarray(x) for x in host_out)
         # the bulk fetch above IS the sync boundary: dispatch-to-fetch wall
         # time is this program's device window (the devmetrics window rides
         # the same fetch — no extra round trip)
         self.last_devmetrics = self.devmetrics.flush(
-            host_dev, bucket=str(bucket)
+            host_dev, bucket=str(handle.bucket)
         )
-        step.account(time.perf_counter() - t0)  # nondet-ok(same measurement)
+        handle.step.account(time.perf_counter() - handle.t0)  # nondet-ok(same measurement)
         return host
+
+    def run(self, bucket: int, binst, bjobs, keys, degraded: bool = False,
+            request_ids=None, width: Optional[int] = None):
+        """One fused dispatch, synced immediately: `fetch(dispatch(...))`."""
+        return self.fetch(self.dispatch(
+            bucket, binst, bjobs, keys, degraded=degraded,
+            request_ids=request_ids, width=width,
+        ))
 
     def hot_reload(self, model_dir: str, which: str = "orbax") -> Optional[int]:
         """Swap in the latest checkpoint under `model_dir/{which}` if it is

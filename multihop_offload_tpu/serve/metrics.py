@@ -33,6 +33,13 @@ class _BucketStats:
     occupancy_sum: float = 0.0     # real requests / slots, summed per dispatch
     waste_jobs_sum: float = 0.0    # job-slot padding waste, summed per dispatch
     waste_nodes_sum: float = 0.0
+    width_sum: int = 0             # compiled width actually ticked (ladder rung)
+    slots_saved: int = 0           # full-capacity slots the ladder did NOT tick
+
+
+# occupancy histogram edges: the ladder's power-of-two rungs expressed as
+# capacity fractions — each bucket boundary is "would a narrower rung fit?"
+OCCUPANCY_BUCKETS = (0.0625, 0.125, 0.25, 0.5, 0.75, 1.0)
 
 
 @dataclasses.dataclass
@@ -81,12 +88,20 @@ class ServingStats:
         ).inc(outcome=outcome)
 
     def record_dispatch(self, b: int, n_real: int, slots: int, waste: dict,
-                        degraded: bool) -> None:
+                        degraded: bool, width: Optional[int] = None) -> None:
+        """One fused dispatch: `slots` is the bucket's full capacity, `width`
+        the compiled width actually ticked (ladder rung; defaults to full).
+        Occupancy is measured against CAPACITY — the signal the ladder and
+        the `ragged` bench leg read — while padding waste is measured against
+        the width paid for."""
+        w = slots if width is None else int(width)
         s = self.bucket(b)
         s.dispatches += 1
         s.degraded_dispatches += int(degraded)
         s.served += n_real
         s.occupancy_sum += n_real / slots
+        s.width_sum += w
+        s.slots_saved += max(slots - w, 0)
         s.waste_jobs_sum += waste["jobs"]
         s.waste_nodes_sum += waste["nodes"]
         reg = _registry()
@@ -97,6 +112,25 @@ class ServingStats:
             "mho_serve_pad_waste_jobs_total",
             "padded job slots computed and discarded",
         ).inc(waste["jobs"], bucket=str(b))
+        reg.histogram(
+            "mho_serve_bucket_occupancy",
+            "real requests / slot capacity per dispatch",
+            buckets=OCCUPANCY_BUCKETS,
+        ).observe(n_real / slots, bucket=str(b))
+        pad_slots = w - n_real
+        if pad_slots > 0:
+            reg.counter(
+                "mho_serve_pad_waste_slots_total",
+                "batch slots ticked with no real request in them",
+            ).inc(pad_slots, bucket=str(b))
+
+    def record_ladder_transition(self, b: int, old: int, new: int) -> None:
+        """One occupancy-ladder rung change (telemetry only — the ladder
+        itself lives in `serve.bucketing.OccupancyLadder`)."""
+        _registry().counter(
+            "mho_serve_ladder_transitions_total",
+            "occupancy-ladder width changes",
+        ).inc(bucket=str(b), direction="widen" if new > old else "narrow")
 
     def record_batch(self, n_real: int, decisions: int, degraded: bool,
                      latencies_s: List[float],
@@ -151,6 +185,8 @@ class ServingStats:
                 "mean_occupancy": round(s.occupancy_sum / d, 4),
                 "mean_pad_waste_jobs": round(s.waste_jobs_sum / d, 4),
                 "mean_pad_waste_nodes": round(s.waste_nodes_sum / d, 4),
+                "mean_width": round(s.width_sum / d, 2),
+                "slots_saved": s.slots_saved,
             }
         served = max(self.served, 1)
         out = {

@@ -19,6 +19,7 @@ same requests realizes identical decisions (the bit-parity property
 
 from __future__ import annotations
 
+import dataclasses
 import time
 from collections import deque
 from typing import Callable, Deque, List, Optional, Tuple
@@ -27,10 +28,12 @@ import jax
 import numpy as np
 
 from multihop_offload_tpu.obs import events as obs_events
+from multihop_offload_tpu.obs import jaxhooks
 from multihop_offload_tpu.obs import trace as obs_trace
 from multihop_offload_tpu.obs.registry import registry as obs_registry
 from multihop_offload_tpu.obs.spans import span
 from multihop_offload_tpu.serve.bucketing import (
+    OccupancyLadder,
     ShapeBuckets,
     pack_bucket,
     padding_waste,
@@ -43,6 +46,28 @@ from multihop_offload_tpu.serve.guards import validate_request
 from multihop_offload_tpu.serve.metrics import ServingStats
 from multihop_offload_tpu.serve.request import OffloadRequest, OffloadResponse
 from multihop_offload_tpu.utils.durable import with_backoff
+
+
+@dataclasses.dataclass
+class _TickBatch:
+    """One bucket's dispatched-but-not-yet-settled batch.
+
+    Phase A of the tick builds these (pack + dispatch, no sync); phase B
+    settles them (fetch + demux + accounting).  In overlap mode a batch
+    settles on the NEXT tick, after that tick's packs have been issued —
+    host pack of tick t+1 then overlaps device compute of tick t."""
+
+    bucket: int
+    taken: List[Tuple[OffloadRequest, float]]
+    reqs: List[OffloadRequest]
+    ids: Optional[List[int]]
+    degraded: bool
+    pad: object
+    width: int
+    t_start: float
+    placed: tuple
+    handle: object = None      # executor.DispatchHandle (single-device path)
+    out: Optional[tuple] = None  # already-fetched host arrays (sharded path)
 
 
 class OffloadService:
@@ -73,6 +98,10 @@ class OffloadService:
         mesh_devices: Optional[List] = None,
         replan_every: int = 16,
         placement_hysteresis: float = 0.2,
+        ragged: bool = False,
+        overlap: bool = False,
+        ladder_alpha: float = 0.5,
+        ladder_hysteresis: float = 0.25,
     ):
         from multihop_offload_tpu.layouts import resolve_layout
         from multihop_offload_tpu.precision import resolve_precision
@@ -111,7 +140,7 @@ class OffloadService:
             self.executor = BucketExecutor(
                 model, variables, buckets,
                 apsp_impl=apsp_impl, fp_impl=fp_impl, prob=prob,
-                precision=self.precision, layout=self.layout,
+                precision=self.precision, layout=self.layout, slots=slots,
             )
         self.replan_every = max(1, int(replan_every))
         # per-bucket admitted arrivals in the current planning window (the
@@ -148,6 +177,38 @@ class OffloadService:
         ]
         self._base_key = jax.random.PRNGKey(seed)
         self._hop_cache: dict = {}
+        # ---- ragged serving: occupancy ladder + overlapped ticks ----------
+        # `ragged` turns on the occupancy-aware width ladder: cold buckets
+        # tick at a narrower compiled width (single-device executor only —
+        # the sharded executor's placement already spreads the batch axis).
+        # `overlap` defers each tick's device sync to the NEXT tick, so host
+        # packing overlaps device compute (cross-tick double buffering).
+        self.ragged = bool(ragged)
+        self.overlap = bool(overlap)
+        self.ladder: Optional[OccupancyLadder] = None
+        if self.ragged and self.planner is None:
+            self.ladder = OccupancyLadder(
+                len(buckets.pads), slots,
+                alpha=ladder_alpha, hysteresis=ladder_hysteresis,
+            )
+        self._ladder_seen = 0         # transitions already mirrored to stats
+        self._pending: List[_TickBatch] = []
+        # per-bucket request-id blocks for the batched key fold, two per
+        # bucket (tick-parity double buffering: an overlapped tick never
+        # rewrites the block whose transfer may still be in flight).  One
+        # vmapped fold_in program replaces the per-key fold + np.stack the
+        # tick used to pay — host key work is O(live), not O(slots).
+        self._id_blocks = [
+            (np.zeros((slots,), np.uint32), np.zeros((slots,), np.uint32))
+            for _ in buckets.pads
+        ]
+        base = self._base_key
+
+        def _fold_block(ids, _k=base):
+            return jax.vmap(lambda rid: jax.random.fold_in(_k, rid))(ids)
+
+        self._fold_keys = jax.jit(_fold_block)  # retrace-ok(one build per ladder width, inside expected_rebuild)
+        self._fold_widths: set = set()
         # the last submit()'s admission verdict: "admitted" | "backpressure"
         # | "too_large" | "rejected_invalid".  Closed-loop clients use it to
         # tell a retryable refusal (backpressure) from a permanent one —
@@ -305,107 +366,191 @@ class OffloadService:
     def request_key(self, request_id: int):
         return jax.random.fold_in(self._base_key, np.uint32(request_id))
 
+    def _key_block(self, b: int, reqs, width: int):
+        """Padded per-slot PRNG keys for one dispatch — O(live) host work.
+
+        Writes only the fresh request ids into the bucket's preallocated id
+        block (pad slots repeat the last real id, so pad keys equal the last
+        real key — the pre-existing pad rule), then runs ONE vmapped
+        `fold_in` program over the block.  Each request's key is still
+        bitwise `fold_in(PRNGKey(seed), request_id)`: threefry is exact
+        integer math, so the batched fold realizes the identical bits the
+        old per-key host fold + np.stack produced."""
+        blk = self._id_blocks[b][self.stats.ticks % 2]
+        live = len(reqs)
+        blk[:live] = [r.request_id for r in reqs]
+        blk[live:width] = blk[live - 1]
+        view = blk[:width]
+        if width not in self._fold_widths:
+            # first dispatch at this width: the fold program build is an
+            # expected compile, same as the rung program it feeds
+            with jaxhooks.expected_rebuild():
+                keys = self._fold_keys(view)
+            self._fold_widths.add(width)
+        else:
+            keys = self._fold_keys(view)
+        return keys
+
+    def _dispatch_bucket(self, b: int, q, now: Optional[float],
+                         overlapping: bool) -> _TickBatch:
+        """Phase A for one non-empty bucket: degraded verdict, ladder width,
+        pack, key fold, and the (sync-free) program dispatch."""
+        t_now = self.clock() if now is None else now
+        held = self._degraded_until.get(b)
+        if held is not None and t_now >= held:
+            # watchdog recovery window over: retry the GNN program
+            del self._degraded_until[b]
+            held = None
+            obs_registry().counter(
+                "mho_watchdog_recoveries_total",
+                "buckets restored to the GNN program",
+            ).inc(bucket=b)
+            obs_events.emit("watchdog_recovered", bucket=b)
+        placed = (self.executor.devices_for(b)
+                  if self.planner is not None else ())
+        # a stuck DEVICE degrades only the buckets placed on it —
+        # per-shard, never fleet-wide
+        dev_stuck = bool(placed) and self._devices_stuck(placed, t_now)
+        degraded = ((t_now - q[0][1]) > self.deadline_s
+                    or held is not None or dev_stuck)
+        width = self.slots
+        if self.ladder is not None:
+            width = self.ladder.select(b, len(q))
+            for bb, old, new in self.ladder.transitions[self._ladder_seen:]:
+                self.stats.record_ladder_transition(bb, old, new)
+                obs_events.emit("ladder_transition", bucket=bb,
+                                old_width=old, new_width=new)
+            self._ladder_seen = len(self.ladder.transitions)
+        # the ladder never selects below min(pending, slots): the take is
+        # exactly what the full-width policy would take
+        taken = [q.popleft() for _ in range(min(width, len(q)))]
+        reqs = [r for r, _ in taken]
+        pad = self.buckets[b]
+        tracing = self._tracing()
+        ids = [r.request_id for r in reqs] if tracing else None
+        # overlapped packs are NOT input-wait: the device is computing the
+        # previous tick while this pack runs, so the span lands outside the
+        # "/pack" input class the obs report charges against the device
+        with span("serve/pack/overlapped" if overlapping else "serve/pack"):
+            binst, bjobs = pack_bucket(
+                reqs, pad, width, dtype=self.dtype,
+                hop_cache=self._hop_cache, layout=self.layout,
+            )
+        if tracing:
+            obs_trace.hop("pack", ids, bucket=b, degraded=bool(degraded),
+                          width=width)
+        keys = self._key_block(b, reqs, width)
+        if self.ladder is not None:
+            self.ladder.observe(b, len(reqs))
+        if self.planner is not None:
+            # the sharded executor owns its own sync (per-placement fetch):
+            # run it to completion here; phase B only demuxes
+            out = self.executor.run(
+                b, binst, bjobs, np.asarray(keys),  # host-sync-ok(key block is (slots, 2) uint32 — trivially small)
+                degraded=degraded, request_ids=ids,
+            )
+            return _TickBatch(b, taken, reqs, ids, degraded, pad, width,
+                              t_now, placed, out=out)
+        handle = self.executor.dispatch(
+            b, binst, bjobs, keys, degraded=degraded, request_ids=ids,
+            width=width,
+        )
+        return _TickBatch(b, taken, reqs, ids, degraded, pad, width,
+                          t_now, placed, handle=handle)
+
+    def _settle_batch(self, batch: _TickBatch,
+                      now: Optional[float]) -> List[OffloadResponse]:
+        """Phase B for one dispatched batch: the bulk device->host fetch,
+        watchdog verdict, demux, capture, and accounting."""
+        b = batch.bucket
+        out = (batch.out if batch.handle is None
+               else self.executor.fetch(batch.handle))
+        t_done = self.clock() if now is None else now
+        if self.watchdog is not None:
+            # clamp at zero: backward clock skew must not trip it
+            verdict = self.watchdog.observe(
+                b, max(t_done - batch.t_start, 0.0), now=t_done,
+                devices=batch.placed or None,
+            )
+            if verdict == "stuck" and self.watchdog.recovery_s > 0:
+                if batch.placed:
+                    # per-shard: pin the stuck window to the DEVICES
+                    # this bucket ran on; co-placed buckets degrade,
+                    # buckets on other chips keep the GNN
+                    until = t_done + self.watchdog.recovery_s
+                    for d in batch.placed:
+                        self._stuck_devices[d] = until
+                else:
+                    self._degraded_until[b] = (
+                        t_done + self.watchdog.recovery_s
+                    )
+        shards = None
+        if batch.placed:
+            shards = [
+                str(getattr(d, "id", d))
+                for d in (self.executor.shard_of_slot(b, i)
+                          for i in range(len(batch.taken)))
+            ]
+        batch_responses = demux_responses(
+            batch.taken, out, "baseline" if batch.degraded else "gnn", b,
+            t_done, shards=shards,
+        )
+        if batch.ids is not None:
+            obs_trace.hop(
+                "decision", batch.ids, bucket=b,
+                served_by="baseline" if batch.degraded else "gnn",
+                latency_s=[round(r.latency_s, 6)
+                           for r in batch_responses],
+            )
+        self._capture_outcomes(batch.reqs, batch_responses)
+        waste = padding_waste(batch.reqs, batch.pad, batch.width)
+        self.stats.record_dispatch(
+            b, len(batch.reqs), self.slots, waste, batch.degraded,
+            width=batch.width,
+        )
+        self.stats.record_batch(
+            len(batch.reqs), sum(r.num_jobs for r in batch.reqs),
+            batch.degraded,
+            [max(t_done - t_enq, 0.0) for _, t_enq in batch.taken],
+            shards=shards,
+        )
+        self._check_nonfinite(
+            b, batch.ids or [r.request_id for r in batch.reqs]
+        )
+        return batch_responses
+
     def tick(self, now: Optional[float] = None) -> List[OffloadResponse]:
-        """Serve one batch per non-empty bucket; returns demuxed responses."""
+        """Serve one batch per non-empty bucket; returns demuxed responses.
+
+        Phase A dispatches EVERY non-empty bucket's program before phase B
+        pays any device sync, so bucket k+1's host pack overlaps bucket k's
+        device compute.  With `overlap=True` the split crosses ticks too:
+        this tick settles the PREVIOUS tick's dispatches after issuing its
+        own, and the responses it returns are for those earlier batches
+        (the final partial tick is settled by `drain`/the next tick)."""
         self.stats.ticks += 1
         if self.planner is not None:
             self._between_ticks(now)
         responses: List[OffloadResponse] = []
         degraded_batches = 0
         with span("serve/tick"):
+            inflight, self._pending = self._pending, []
+            batches: List[_TickBatch] = []
             for b, q in enumerate(self._queues):
                 if not q:
                     continue
-                t_now = self.clock() if now is None else now
-                held = self._degraded_until.get(b)
-                if held is not None and t_now >= held:
-                    # watchdog recovery window over: retry the GNN program
-                    del self._degraded_until[b]
-                    held = None
-                    obs_registry().counter(
-                        "mho_watchdog_recoveries_total",
-                        "buckets restored to the GNN program",
-                    ).inc(bucket=b)
-                    obs_events.emit("watchdog_recovered", bucket=b)
-                placed = (self.executor.devices_for(b)
-                          if self.planner is not None else ())
-                # a stuck DEVICE degrades only the buckets placed on it —
-                # per-shard, never fleet-wide
-                dev_stuck = bool(placed) and self._devices_stuck(placed, t_now)
-                degraded = ((t_now - q[0][1]) > self.deadline_s
-                            or held is not None or dev_stuck)
-                degraded_batches += int(degraded)
-                taken = [q.popleft() for _ in range(min(self.slots, len(q)))]
-                reqs = [r for r, _ in taken]
-                pad = self.buckets[b]
-                tracing = self._tracing()
-                ids = [r.request_id for r in reqs] if tracing else None
-                with span("serve/pack"):
-                    binst, bjobs = pack_bucket(
-                        reqs, pad, self.slots, dtype=self.dtype,
-                        hop_cache=self._hop_cache, layout=self.layout,
-                    )
-                if tracing:
-                    obs_trace.hop("pack", ids, bucket=b,
-                                  degraded=bool(degraded))
-                keys = [self.request_key(r.request_id) for r in reqs]
-                while len(keys) < self.slots:   # pad slots reuse the last key
-                    keys.append(keys[-1])
-                out = self.executor.run(
-                    b, binst, bjobs,
-                    np.stack([np.asarray(k)  # host-sync-ok(PRNG keys are built host-side; one stack per batch)
-                              for k in keys]),
-                    degraded=degraded, request_ids=ids,
+                batch = self._dispatch_bucket(
+                    b, q, now, overlapping=bool(inflight)
                 )
-                t_done = self.clock() if now is None else now
-                if self.watchdog is not None:
-                    # clamp at zero: backward clock skew must not trip it
-                    verdict = self.watchdog.observe(
-                        b, max(t_done - t_now, 0.0), now=t_done,
-                        devices=placed or None,
-                    )
-                    if verdict == "stuck" and self.watchdog.recovery_s > 0:
-                        if placed:
-                            # per-shard: pin the stuck window to the DEVICES
-                            # this bucket ran on; co-placed buckets degrade,
-                            # buckets on other chips keep the GNN
-                            until = t_done + self.watchdog.recovery_s
-                            for d in placed:
-                                self._stuck_devices[d] = until
-                        else:
-                            self._degraded_until[b] = (
-                                t_done + self.watchdog.recovery_s
-                            )
-                shards = None
-                if placed:
-                    shards = [
-                        str(getattr(d, "id", d))
-                        for d in (self.executor.shard_of_slot(b, i)
-                                  for i in range(len(taken)))
-                    ]
-                batch_responses = demux_responses(
-                    taken, out, "baseline" if degraded else "gnn", b, t_done,
-                    shards=shards,
-                )
-                if tracing:
-                    obs_trace.hop(
-                        "decision", ids, bucket=b,
-                        served_by="baseline" if degraded else "gnn",
-                        latency_s=[round(r.latency_s, 6)
-                                   for r in batch_responses],
-                    )
-                responses.extend(batch_responses)
-                self._capture_outcomes(reqs, batch_responses)
-                waste = padding_waste(reqs, pad, self.slots)
-                self.stats.record_dispatch(
-                    b, len(reqs), self.slots, waste, degraded
-                )
-                self.stats.record_batch(
-                    len(reqs), sum(r.num_jobs for r in reqs), degraded,
-                    [max(t_done - t_enq, 0.0) for _, t_enq in taken],
-                    shards=shards,
-                )
-                self._check_nonfinite(b, ids or [r.request_id for r in reqs])
+                degraded_batches += int(batch.degraded)
+                batches.append(batch)
+            if self.overlap:
+                self._pending = batches
+                settle = inflight
+            else:
+                settle = inflight + batches
+            for batch in settle:
+                responses.extend(self._settle_batch(batch, now))
         depth = self.queue_depth
         obs_registry().gauge(
             "mho_serve_queue_depth", "pending admitted requests"
@@ -481,10 +626,13 @@ class OffloadService:
             ).inc(captured)
 
     def drain(self, max_ticks: int = 1000) -> List[OffloadResponse]:
-        """Tick until every admitted request is answered (bounded)."""
+        """Tick until every admitted request is answered (bounded).  In
+        overlap mode the loop runs one extra settle-only tick for the final
+        in-flight batches — conservation (every admitted request answered
+        exactly once) holds in both modes."""
         responses: List[OffloadResponse] = []
         for _ in range(max_ticks):
-            if self.queue_depth == 0:
+            if self.queue_depth == 0 and not self._pending:
                 break
             responses.extend(self.tick())
         return responses

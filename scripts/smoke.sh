@@ -5,7 +5,7 @@
 #
 # Chains (each must pass; total budget a few minutes on a CPU host):
 #   1. bash scripts/lint.sh          — ruff (or the engine's pyflakes set)
-#      plus the repo's JAX-aware rules (JX001-JX011, MP001, SL001,
+#      plus the repo's JAX-aware rules (JX001-JX012, MP001, SL001,
 #      OB001-OB003);
 #   2. mho-lint --json               — the static-analysis engine alone,
 #      proving the JSON surface and the seeded-violation fixture dir
@@ -47,13 +47,20 @@
 #      virtual host devices (XLA_FLAGS=--xla_force_host_platform_device_
 #      count=8): serves a window and asserts >1 device actually computed
 #      the batch, read off the output arrays' sharding;
-#  10. mho-bench --matrix --smoke  — the gate-campaign runner on a tiny
+#  10. ragged serve smoke          — an occupancy-ladder + overlapped-tick
+#      OffloadService under bursty LOW-occupancy loadgen traffic (MMPP
+#      arrivals): every admitted request answered exactly once, the
+#      ladder actually narrowed (a sub-full-width rung program served),
+#      zero unexpected retraces after steady, and the mho-obs report of
+#      the run log renders the `mho_serve_bucket_occupancy` histogram +
+#      pad-waste counters in its serving section;
+#  11. mho-bench --matrix --smoke  — the gate-campaign runner on a tiny
 #      CPU cross-product (dense+sparse, bf16, fused-kernel and fp-rung
 #      legs in one process): asserts the bench_matrix.json record schema
 #      is complete, on-chip gates stay null off-TPU, shipped defaults
 #      stay fp32+dense, fallback paths are reported honestly, and zero
 #      unexpected retraces across legs;
-#  11. mho-fuzz --smoke            — the semantic-guardrail proof: every
+#  12. mho-fuzz --smoke            — the semantic-guardrail proof: every
 #      request-mutation family refused at admission with its catalogued
 #      typed reason (zero uncontained), valid traffic bit-identical with
 #      garbage interleaved, admitted == served conservation, a
@@ -61,13 +68,13 @@
 #      hot-reload (champion keeps serving), byte-corrupt steps
 #      quarantined, zero unexpected retraces and non-finite sentinels at
 #      zero; writes benchmarks/fuzz_smoke.json;
-#  12. mho-rl --smoke              — the on-device closed loop end to end:
+#  13. mho-rl --smoke              — the on-device closed loop end to end:
 #      one compiled program per train step (zero unexpected retraces
 #      after the first), devmetrics episode counters == host-side packet
 #      conservation exactly, and the REINFORCE-trained policy beating its
 #      random init on sim delivered-ratio at rho >= 0.7 on the fixed
 #      seed; writes benchmarks/rl_smoke.json;
-#  13. mho-mesh --smoke            — planet-scale serving proven on one
+#  14. mho-mesh --smoke            — planet-scale serving proven on one
 #      CPU host: TWO local processes form a real jax.distributed group
 #      (4 global devices), serve under a DCN-aware two-level plan (no
 #      bucket spans a host), decisions bit-identical to the single-host
@@ -76,7 +83,7 @@
 #      replan onto the survivor with conservation and zero unexpected
 #      retraces, and an open-loop bisection committing the max sustained
 #      req/s at the p99 SLO; writes benchmarks/mesh_smoke.json;
-#  14. mho-scenarios --matrix --smoke — the scenario-matrix drill (<90 s):
+#  15. mho-scenarios --matrix --smoke — the scenario-matrix drill (<90 s):
 #      a preset subset covering every NEW topology family (grid, corridor,
 #      two-tier edge-cloud) plus a failure schedule and a mobility leg,
 #      each through BOTH the analytic evaluator and FleetSim with exact
@@ -91,10 +98,10 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
-echo "== [1/14] lint =="
+echo "== [1/15] lint =="
 bash scripts/lint.sh
 
-echo "== [2/14] mho-lint (engine: clean repo + every rule fires on seeds) =="
+echo "== [2/15] mho-lint (engine: clean repo + every rule fires on seeds) =="
 python -m multihop_offload_tpu.analysis.cli --json >/dev/null
 python - <<'EOF'
 import json, subprocess, sys
@@ -103,14 +110,14 @@ out = subprocess.run(
      "tests/fixtures/analysis_seeded"], capture_output=True, text=True)
 fired = {f["rule"] for f in json.loads(out.stdout)["findings"]}
 need = {"JX001", "JX002", "JX003", "JX004", "JX005", "JX006", "JX007",
-        "JX008", "JX009", "JX010", "JX011", "MP001", "SL001", "OB001",
-        "OB002", "OB003"}
+        "JX008", "JX009", "JX010", "JX011", "JX012", "MP001", "SL001",
+        "OB001", "OB002", "OB003"}
 missing = sorted(need - fired)
 assert not missing, f"rules silent on their seeded violations: {missing}"
 print(f"mho-lint: all {len(need)} repo rules fire on the seeded fixtures")
 EOF
 
-echo "== [3/14] mho-sim --smoke (+ device metrics in the run report) =="
+echo "== [3/15] mho-sim --smoke (+ device metrics in the run report) =="
 SIM_LOG="$(mktemp -d)/run.jsonl"
 python -m multihop_offload_tpu.cli.sim --smoke --obs_log "$SIM_LOG"
 python - "$SIM_LOG" <<'EOF'
@@ -138,22 +145,22 @@ assert host == dev, f"devmetrics diverge from SimState: host={host} dev={dev}"
 print(f"devmetrics == SimState: {host} (exact), report section present")
 EOF
 
-echo "== [4/14] mho-sim --smoke --layout sparse =="
+echo "== [4/15] mho-sim --smoke --layout sparse =="
 python -m multihop_offload_tpu.cli.sim --smoke --layout sparse
 
-echo "== [5/14] mho-loop --smoke =="
+echo "== [5/15] mho-loop --smoke =="
 python -m multihop_offload_tpu.cli.loop --smoke
 
-echo "== [6/14] mho-chaos --smoke =="
+echo "== [6/15] mho-chaos --smoke =="
 python -m multihop_offload_tpu.cli.chaos --smoke
 
-echo "== [7/14] mho-health --smoke =="
+echo "== [7/15] mho-health --smoke =="
 python -m multihop_offload_tpu.cli.health --smoke
 
-echo "== [8/14] mho-prof --smoke =="
+echo "== [8/15] mho-prof --smoke =="
 python -m multihop_offload_tpu.cli.prof --smoke
 
-echo "== [9/14] sharded serve smoke (8 virtual devices) =="
+echo "== [9/15] sharded serve smoke (8 virtual devices) =="
 XLA_FLAGS="--xla_force_host_platform_device_count=8" python - <<'PYEOF'
 from multihop_offload_tpu.cli.serve import build_service
 from multihop_offload_tpu.config import Config
@@ -172,25 +179,100 @@ print(f"sharded serve: {len(responses)} requests over {used} devices, "
       f"placement {service.planner.plan.describe()}")
 PYEOF
 
-echo "== [10/14] mho-bench --matrix --smoke =="
+echo "== [10/15] ragged serve smoke (ladder + overlap under bursty traffic) =="
+SERVE_LOG="$(mktemp -d)/serve.jsonl"
+python - "$SERVE_LOG" <<'PYEOF'
+import sys
+import types
+
+import numpy as np
+
+from multihop_offload_tpu import obs
+from multihop_offload_tpu.cli.serve import build_service
+from multihop_offload_tpu.config import Config
+from multihop_offload_tpu.loadgen.arrivals import TrafficModel, arrival_times
+from multihop_offload_tpu.obs import jaxhooks
+from multihop_offload_tpu.serve.workload import case_pool, request_stream
+
+slots, tick_s, n_ticks = 8, 1.0, 16
+cfg = Config(seed=7, dtype="float32", serve_slots=slots, serve_queue_cap=64,
+             serve_deadline_s=1e9, serve_buckets=2,
+             model_root="/nonexistent-model-root",
+             serve_ragged=True, serve_overlap=True)
+pool = case_pool([10, 16], per_size=1, seed=7)
+runlog = obs.start_run(types.SimpleNamespace(obs_log=sys.argv[1]),
+                       role="serve-smoke")
+service, pool = build_service(cfg, pool=pool)
+
+# bursty LOW-occupancy schedule: MMPP trickle that leaves most slots cold
+tm = TrafficModel(base_rate=2.0, mmpp_burst_factor=4.0,
+                  mmpp_dwell_slow_s=6.0, mmpp_dwell_fast_s=1.5)
+arrivals = np.asarray(arrival_times(tm, n_ticks * tick_s, seed=13))
+per_tick = np.bincount(
+    np.minimum((arrivals / tick_s).astype(int), n_ticks - 1),
+    minlength=n_ticks)
+n_req = int(per_tick.sum())
+reqs = iter(request_stream(pool, n_req + 2 * slots, seed=11))
+
+for _ in range(2 * slots):  # warm full-width programs outside steady
+    assert service.submit(next(reqs))
+service.drain()
+before = jaxhooks.unexpected_retraces()
+jaxhooks.mark_steady()
+
+responses = []
+for k in per_tick:
+    for _ in range(int(k)):
+        assert service.submit(next(reqs)), "admission refused mid-smoke"
+    responses.extend(service.tick())
+responses.extend(service.drain())
+jaxhooks.clear_steady()
+obs.finish_run(runlog)
+
+ids = [r.request_id for r in responses]
+assert len(ids) == n_req and len(set(ids)) == n_req, (
+    f"conservation broke: {len(ids)} responses for {n_req} admitted")
+assert service.ladder is not None and service.ladder.transitions, (
+    "low-occupancy traffic never moved the width ladder")
+assert any(w < slots for (_, w) in service.executor._rungs), (
+    "no sub-full-width rung program was ever built")
+retraces = jaxhooks.unexpected_retraces() - before
+assert retraces == 0, f"{retraces} unexpected retraces after steady"
+occ = n_req / (n_ticks * cfg.serve_buckets * slots)
+print(f"ragged serve: {n_req} requests exactly once at "
+      f"{occ:.0%} offered occupancy, "
+      f"{len(service.ladder.transitions)} ladder transitions, 0 retraces")
+PYEOF
+python - "$SERVE_LOG" <<'EOF'
+import subprocess, sys
+report = subprocess.run(
+    [sys.executable, "-m", "multihop_offload_tpu.cli.obs", sys.argv[1]],
+    capture_output=True, text=True, check=True).stdout
+for needle in ("serving", "mho_serve_bucket_occupancy",
+               "mho_serve_pad_waste_slots_total"):
+    assert needle in report, f"obs report missing {needle!r} in serving section"
+print("mho-obs report: occupancy histogram + pad-waste counters present")
+EOF
+
+echo "== [11/15] mho-bench --matrix --smoke =="
 # refreshes the committed benchmarks/bench_matrix.json (the CPU record IS
 # the committed artifact until a chip session fills the on-chip gates)
 python -m multihop_offload_tpu.cli.bench --matrix --smoke
 
-echo "== [11/14] mho-fuzz --smoke =="
+echo "== [12/15] mho-fuzz --smoke =="
 python -m multihop_offload_tpu.cli.fuzz --smoke
 
-echo "== [12/14] mho-rl --smoke =="
+echo "== [13/15] mho-rl --smoke =="
 # refreshes the committed benchmarks/rl_smoke.json (the CPU episodes/s
 # record is the baseline for the on-chip >=127K/chip gate)
 python -m multihop_offload_tpu.cli.rl --smoke
 
-echo "== [13/14] mho-mesh --smoke (2-process mesh federation) =="
+echo "== [14/15] mho-mesh --smoke (2-process mesh federation) =="
 # refreshes the committed benchmarks/mesh_smoke.json (CPU two-process
 # proof; a chip fleet re-runs the same gate over real hosts)
 python -m multihop_offload_tpu.cli.mesh --smoke
 
-echo "== [14/14] mho-scenarios --matrix --smoke =="
+echo "== [15/15] mho-scenarios --matrix --smoke =="
 # refreshes the committed benchmarks/scenario_smoke.json (the full-matrix
 # benchmarks/scenario_matrix.json is refreshed by `mho-scenarios --matrix`)
 python -m multihop_offload_tpu.cli.scenarios --matrix --smoke

@@ -1,0 +1,16 @@
+"""Seeded violation: JX012 (use-after-donate)."""
+
+import jax
+
+
+def _mul(w, x):
+    return w * x
+
+
+step = jax.jit(_mul, donate_argnums=(1,))
+
+
+def run_tick(weights, batch):
+    out = step(weights, batch)
+    # JX012: `batch` was donated to step() — its pages may back `out`
+    return out, batch.sum()

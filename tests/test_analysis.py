@@ -21,8 +21,8 @@ from multihop_offload_tpu.analysis.cli import main as lint_main
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SEEDED = os.path.join(REPO, "tests", "fixtures", "analysis_seeded")
 ALL_REPO_RULES = {"JX001", "JX002", "JX003", "JX004", "JX005", "JX006",
-                  "JX007", "JX008", "JX009", "JX010", "JX011", "MP001",
-                  "SL001", "OB001", "OB002", "OB003"}
+                  "JX007", "JX008", "JX009", "JX010", "JX011", "JX012",
+                  "MP001", "SL001", "OB001", "OB002", "OB003"}
 
 
 def run_on(tmp_path, files, select=None, baseline=None):
@@ -670,6 +670,61 @@ def test_jx011_exempts_graphs_dir(tmp_path):
     assert "JX011" not in rules_hit(rep)
     rep = run_on(tmp_path, {"env/m.py": src})
     assert "JX011" in rules_hit(rep)
+
+
+def test_jx012_tp_waived_and_rebind_guard(tmp_path):
+    rep = run_on(tmp_path, {"serve/m.py": """\
+        import jax
+
+        def _mul(w, x):
+            return w * x
+
+        step = jax.jit(_mul, donate_argnums=(1,))
+
+        def tp(w, batch):
+            out = step(w, batch)
+            return out, batch.sum()
+
+        def waived(w, batch):
+            out = step(w, batch)
+            return out, batch.sum()  # donate-ok(test)
+
+        def rebound(w, batch):
+            batch = step(w, batch)
+            return batch * 2
+
+        def weights_not_donated(w, batch):
+            out = step(w, batch)
+            return w.sum(), out
+    """})
+    jx = [f for f in rep.findings if f.rule == "JX012"]
+    assert [f.line for f in jx] == [10]
+    assert len([f for f in rep.waived if f.rule == "JX012"]) == 1
+
+
+def test_jx012_dynamic_donation_skipped_and_alias_aware(tmp_path):
+    rep = run_on(tmp_path, {"train/m.py": """\
+        import jax
+        from jax import jit as weird_jit
+
+        DONATE = (1,)
+
+        def _f(w, x):
+            return w * x
+
+        dyn = jax.jit(_f, donate_argnums=DONATE)
+        aliased = weird_jit(_f, donate_argnums=1)
+
+        def dynamic_vector_not_tracked(w, batch):
+            out = dyn(w, batch)
+            return out, batch.sum()
+
+        def alias_tp(w, batch):
+            out = aliased(w, batch)
+            return out, batch.sum()
+    """})
+    jx = [f for f in rep.findings if f.rule == "JX012"]
+    assert [f.line for f in jx] == [18]  # only the alias-resolved literal
 
 
 # ---------------------------------------------------------------------------

@@ -549,6 +549,91 @@ def test_fused_chebconv_under_vmap():
     assert _scaled_err(g_fus, g_ref) <= _SCALED_TOL
 
 
+def test_ragged_chebconv_skip_is_bitwise_and_fallback_exact():
+    """The ragged tile's contract: (1) any live count is BIT-IDENTICAL to
+    the same kernel walking the full capacity (skipped inert blocks are
+    exact +0.0); (2) a traced live count serves every occupancy from ONE
+    program; (3) off-TPU non-interpret delegates to the XLA reference
+    bitwise; (4) the bwd recomputes through the reference bitwise."""
+    import jax
+
+    from multihop_offload_tpu.ops.chebconv import (
+        _xla_propagate, chebconv_propagate_ragged, chebconv_ragged_path,
+    )
+
+    rng = np.random.default_rng(37)
+    n, f, live, cap = 12, 6, 17, 300     # cap spans >2 edge blocks at eb=128
+    rows = np.zeros(cap, np.int32)
+    cols = np.zeros(cap, np.int32)
+    vals = np.zeros(cap, np.float32)
+    rows[:live] = rng.integers(0, n, live)
+    cols[:live] = rng.integers(0, n, live)
+    vals[:live] = rng.normal(size=live).astype(np.float32)
+    diag = rng.normal(size=n).astype(np.float32)
+    x = rng.normal(size=(n, f)).astype(np.float32)
+    args = tuple(map(jnp.asarray, (rows, cols, vals, diag, x)))
+
+    ragged = jax.jit(lambda lv: chebconv_propagate_ragged(
+        *args, lv, "float32", True, 128))
+    walked = np.asarray(ragged(jnp.int32(cap)))     # every block runs
+    skipped = np.asarray(ragged(jnp.int32(live)))   # dead blocks skipped
+    np.testing.assert_array_equal(skipped, walked)
+    # a live count of zero leaves exactly the diagonal seed
+    np.testing.assert_allclose(
+        np.asarray(ragged(jnp.int32(0))), diag[:, None] * x, rtol=0, atol=0)
+
+    ref = np.asarray(_xla_propagate(*args, acc=jnp.float32))
+    assert _scaled_err(skipped, ref) <= _SCALED_TOL
+    # off-TPU non-interpret: the masked XLA reference, bitwise
+    fb = chebconv_propagate_ragged(*args, jnp.int32(live), "float32", False)
+    np.testing.assert_array_equal(np.asarray(fb), ref)
+    assert chebconv_ragged_path() == "xla-fallback"
+    assert chebconv_ragged_path(interpret=True) == "pallas"
+
+    g = jnp.asarray(rng.normal(size=ref.shape).astype(np.float32))
+    _, vjp_rag = jax.vjp(
+        lambda v, d, xx: chebconv_propagate_ragged(
+            args[0], args[1], v, d, xx, jnp.int32(live), "float32", True, 128),
+        args[2], args[3], args[4])
+    _, vjp_ref = jax.vjp(
+        lambda v, d, xx: _xla_propagate(
+            args[0], args[1], v, d, xx, jnp.float32),
+        args[2], args[3], args[4])
+    for a, b in zip(vjp_rag(g), vjp_ref(g)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_ragged_chebconv_factory_and_cost_facts():
+    """`make_fused_propagate_ragged` mirrors the dense factory's support
+    signature plus the live count; the analytic executed-cost facts scale
+    with occupancy (the bench matrix's CPU-proxy reduction signal) and the
+    kernel registers under its own prof program name."""
+    from multihop_offload_tpu.obs.prof import prof_registry
+    from multihop_offload_tpu.ops.chebconv import (
+        chebconv_cost_facts, chebconv_ragged_cost_facts,
+        make_fused_propagate_ragged,
+    )
+
+    rng = np.random.default_rng(41)
+    support, x = _sparse_support_case(rng, e=32, f=4)
+    nnz = int(support.edges.rows.shape[0])
+    prop = make_fused_propagate_ragged(interpret=True)
+    full = np.asarray(prop(support, x, jnp.int32(nnz)))
+    rag = np.asarray(prop(support, x, jnp.int32(nnz - 2)))  # pad tail inert
+    np.testing.assert_array_equal(rag, full)
+    rec = prof_registry().get("ops/chebconv_ragged")
+    assert rec is not None and rec.flops > 0
+
+    # edge-dominated shape: executed flops AND bytes scale with occupancy
+    dense = chebconv_cost_facts(64, 8192, 16)
+    low = chebconv_ragged_cost_facts(64, 8192 // 8, 8192, 16)
+    assert dense["flops"] / low["flops"] >= 2.0
+    assert dense["bytes_accessed"] / low["bytes_accessed"] >= 2.0
+    # executed work never exceeds capacity work
+    cap = chebconv_ragged_cost_facts(64, 8192, 8192, 16)
+    assert cap["flops"] == dense["flops"]
+
+
 def test_resolve_chebconv_paths_and_fallback():
     """Executed-path honesty (`pallas_apsp_path` contract) + the knob: the
     off-TPU non-interpret wrapper must EXECUTE (XLA delegate, bitwise the

@@ -273,6 +273,143 @@ def test_repeated_hot_reloads_stay_steady(tmp_path):
         jaxhooks.clear_steady()
 
 
+def test_ragged_occupancy_sweep_bit_identical():
+    """The ladder's load-bearing property: at every occupancy rung (1,
+    slots/4, slots/2, full) the ragged service realizes decisions
+    bit-identical to the dense full-width service — width is purely a
+    throughput transform, like batching itself."""
+    slots = 4
+    dense, pool = _make_service(slots=slots, queue_cap=64)
+    ragged, _ = _make_service(slots=slots, queue_cap=64, serve_ragged=True)
+    occupancies = [1, max(1, slots // 4), slots // 2, slots]
+    # repeat the low rungs so the EWMA actually narrows the ladder before
+    # the parity comparison at those occupancies
+    schedule = occupancies + [1, 1, slots // 2]
+    n_req = sum(schedule)
+    reqs_a = list(request_stream(pool, n_req, seed=61))
+    reqs_b = list(request_stream(pool, n_req, seed=61))
+
+    def run(service, reqs):
+        responses, it = [], iter(reqs)
+        for k in schedule:
+            for _ in range(k):
+                assert service.submit(next(it))
+            responses.extend(service.tick())
+        responses.extend(service.drain())
+        return {r.request_id: r for r in responses}
+
+    by_dense = run(dense, reqs_a)
+    by_ragged = run(ragged, reqs_b)
+    assert sorted(by_dense) == sorted(by_ragged) and len(by_dense) == n_req
+    for rid, r in by_ragged.items():
+        d = by_dense[rid]
+        np.testing.assert_array_equal(r.dst, d.dst)
+        np.testing.assert_array_equal(r.is_local, d.is_local)
+        np.testing.assert_allclose(r.delay_est, d.delay_est,
+                                   rtol=1e-5, atol=1e-6)
+    # the ladder narrowed (rung programs really served) and the occupancy
+    # telemetry flowed: histogram series + pad-waste counter are live
+    assert ragged.ladder is not None
+    assert ragged.ladder.transitions, "sweep never narrowed the ladder"
+    assert any(w < slots for (_, w) in ragged.executor._rungs)
+    from multihop_offload_tpu.obs.registry import registry as obs_registry
+
+    snap = obs_registry().snapshot()
+    assert "mho_serve_bucket_occupancy" in snap
+    assert "mho_serve_pad_waste_slots_total" in snap
+
+
+def test_ladder_merge_split_hysteresis():
+    """OccupancyLadder unit rows: immediate widen on a burst, one-rung
+    narrowing only after the (hysteresis-inflated) EWMA clears the rung."""
+    from multihop_offload_tpu.serve.bucketing import OccupancyLadder
+
+    lad = OccupancyLadder(1, 8, alpha=0.5, hysteresis=0.25)
+    assert lad.rungs == [1, 2, 4, 8] and lad.width_of(0) == 8
+    # cold trickle: narrowing is gradual (one rung per tick, EWMA-gated)
+    widths = []
+    for _ in range(6):
+        w = lad.select(0, 1)
+        widths.append(w)
+        lad.observe(0, 1)
+    assert widths[0] == 8, "first tick must not narrow below the EWMA"
+    assert widths == sorted(widths, reverse=True), "narrowing skipped a rung"
+    assert lad.width_of(0) == 2, (
+        "live=1 settles at rung 2: ewma->1 never clears 1*(1+hysteresis)"
+    )
+    # a burst widens in ONE step, no hysteresis — queued work is never
+    # clipped below what full slots would take
+    assert lad.select(0, 7) == 8
+    assert lad.width_of(0) == 8
+    # width always covers min(pending, slots)
+    for pending in (1, 3, 5, 9):
+        assert lad.select(0, pending) >= min(pending, lad.slots)
+    # jitter around a rung boundary must not thrash: with the EWMA still
+    # burst-inflated, a single cold tick cannot narrow
+    lad2 = OccupancyLadder(1, 8, alpha=0.5, hysteresis=0.25)
+    lad2.observe(0, 8)
+    assert lad2.select(0, 1) == 8
+    transitions_before = len(lad2.transitions)
+    assert lad2.select(0, 1) == 8  # ewma 8 -> still > 4/(1+h)
+    assert len(lad2.transitions) == transitions_before
+
+
+def test_overlap_conservation_exactly_once():
+    """Overlapped ticks answer every admitted request exactly once: the
+    responses just arrive one tick later (the final batch on drain)."""
+    slots = 2
+    service, pool = _make_service(slots=slots, queue_cap=64,
+                                  serve_ragged=True, serve_overlap=True)
+    reqs = list(request_stream(pool, 9, seed=71))
+    seen = []
+    it = iter(reqs)
+    # interleave submits with ticks, including empty-queue ticks mid-stream
+    for k in (2, 0, 3, 1, 0, 2, 1):
+        for _ in range(k):
+            assert service.submit(next(it))
+        seen.extend(service.tick())
+    seen.extend(service.drain())
+    ids = sorted(r.request_id for r in seen)
+    assert ids == sorted(r.request_id for r in reqs)
+    assert len(ids) == len(set(ids)) == len(reqs)
+    assert not service._pending and service.queue_depth == 0
+    s = service.stats.summary(wall_s=1.0)
+    assert s["served"] == len(reqs)
+
+
+def test_width_transitions_zero_unexpected_retraces():
+    """Ladder width changes compile rung programs inside expected_rebuild:
+    after steady state, narrowing and re-widening must not count a single
+    unexpected retrace (the bench-matrix invariant, pinned here)."""
+    from multihop_offload_tpu.obs import jaxhooks
+
+    slots = 4
+    service, pool = _make_service(slots=slots, queue_cap=64,
+                                  serve_ragged=True, serve_overlap=True)
+    reqs = list(request_stream(pool, 4 * slots + 12, seed=81))
+    it = iter(reqs)
+    # warm the full-width programs and the key-fold at full width
+    for _ in range(2 * slots):
+        service.submit(next(it))
+    service.drain()
+    before = jaxhooks.unexpected_retraces()
+    jaxhooks.mark_steady()
+    try:
+        # trickle narrows the ladder (new rung programs + key folds), then
+        # a burst widens back to the already-built full width
+        for k in (1, 1, 1, 1, 1, 1, slots, 1, 1):
+            for _ in range(k):
+                service.submit(next(it))
+            service.tick()
+        service.drain()
+        assert service.ladder.transitions, "test never exercised the ladder"
+        assert jaxhooks.unexpected_retraces() == before, (
+            "a ladder width transition retraced outside expected_rebuild"
+        )
+    finally:
+        jaxhooks.clear_steady()
+
+
 @pytest.mark.slow
 def test_loadgen_soak(tmp_path):
     """The committed-record path end to end at reduced scale: both legs,
